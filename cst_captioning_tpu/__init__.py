@@ -1,0 +1,35 @@
+"""cst_captioning_tpu — a TPU-native video-captioning framework.
+
+A from-scratch JAX/XLA/Flax re-design of the capabilities of the reference
+``xiadingZ/cst_captioning`` PyTorch codebase (BMVC 2017, "Consensus-based
+Sequence Training for Video Captioning", arXiv:1712.09532):
+
+* LSTM caption decoder over pre-extracted video features (ResNet-152, C3D,
+  MFCC audio, category embeddings) — reference ``model.py``.
+* Three training regimes — reference ``train.py``:
+  XE (teacher forcing), WXE / CST_GT_None (consensus-weighted XE), and
+  CST_MS (consensus-based self-critical REINFORCE with in-loop CIDEr-D).
+* Greedy / multinomial sampling and fixed-shape beam search under ``jit`` —
+  reference ``sample.py`` / ``model.py``.
+* Vendored pure-Python metric suite (PTB tokenization, BLEU, ROUGE-L,
+  CIDEr-D, METEOR) — reference ``coco-caption`` / ``cider`` submodules.
+* Data-parallel + tensor-parallel execution over a ``jax.sharding.Mesh``
+  (the reference's ``.cuda()`` / ``nn.DataParallel``, rebuilt on ICI
+  collectives).
+
+NOTE: at build time ``/root/reference`` was an empty directory (see
+SURVEY.md header), so docstring citations refer to the reference's public
+layout (file names per SURVEY.md §2) rather than file:line into the mount.
+"""
+
+__version__ = "0.1.0"
+
+from cst_captioning_tpu.config import (  # noqa: F401
+    Config,
+    DataConfig,
+    ModelConfig,
+    TrainConfig,
+    EvalConfig,
+    get_preset,
+    PRESETS,
+)

@@ -1,0 +1,321 @@
+"""Typed configuration system.
+
+Replaces the reference's ``opts.py`` (argparse, ~200-400 LoC of flags) and the
+``Makefile`` variable layering (dataset / feature set / training stage).  Every
+reference flag has a field here; ``docs/PARITY.md`` holds the flag-for-flag
+table.  Presets 1-5 mirror the driver acceptance configs (BASELINE.json:6-12).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+
+@dataclass
+class DataConfig:
+    """Data paths and batching — reference ``opts.py`` data flags + ``dataloader.py``.
+
+    The reference opens N feature h5 files (one per modality), a label h5
+    (encoded captions + per-video start/end index) and "cocofmt" ground-truth
+    JSONs per split.
+    """
+
+    dataset: str = "msvd"  # msvd (yt2t) | msrvtt | synthetic
+    # One h5 (or .npz shard dir) per feature modality, keyed by modality name.
+    feature_files: Dict[str, str] = field(default_factory=dict)
+    # Modalities actually fed to the model, in fusion order.
+    feature_modalities: List[str] = field(default_factory=lambda: ["resnet"])
+    label_file: str = ""          # encoded captions + per-video index
+    vocab_file: str = ""          # id -> word json
+    cocofmt_files: Dict[str, str] = field(default_factory=dict)  # split -> GT json
+    idf_file: str = ""            # CIDEr document-frequency pickle/json
+    consensus_file: str = ""      # per-caption WXE consensus CIDEr weights (npy/json)
+
+    batch_size: int = 64          # videos per batch
+    seq_per_img: int = 17         # captions sampled per video (20 msrvtt, 17 msvd)
+    max_seq_len: int = 30         # tokens incl. BOS/EOS padding target
+    max_frames: int = 28          # temporal length features are padded/pooled to
+    feature_dims: Dict[str, int] = field(default_factory=lambda: {"resnet": 2048})
+    num_categories: int = 20      # MSR-VTT category vocabulary (0 disables)
+    shuffle: bool = True
+    drop_last: bool = True
+
+
+@dataclass
+class ModelConfig:
+    """Decoder architecture — reference ``model.py`` flags in ``opts.py``."""
+
+    vocab_size: int = 0           # filled from vocab at build time
+    rnn_size: int = 512           # LSTM hidden size
+    num_layers: int = 1           # 1-2 layer LSTM
+    input_encoding_size: int = 512  # word/feature embedding dim
+    feature_fusion: str = "meanpool"  # meanpool | attention | concat
+    att_hidden_size: int = 512    # temporal-attention MLP width
+    drop_prob: float = 0.5        # dropout on LM input/output
+    scheduled_sampling_start: int = -1   # epoch to start ss (-1 = off)
+    scheduled_sampling_increase_every: int = 5
+    scheduled_sampling_increase_prob: float = 0.05
+    scheduled_sampling_max_prob: float = 0.25
+    use_category: bool = False    # MSR-VTT category embedding as extra modality
+    category_embed_size: int = 64
+    param_dtype: str = "float32"
+    compute_dtype: str = "bfloat16"   # MXU-friendly activations
+    use_pallas_lstm: bool = False     # fused Pallas LSTM cell fast path
+
+
+@dataclass
+class TrainConfig:
+    """Optimization + regime staging — reference ``train.py`` / ``opts.py``."""
+
+    train_mode: str = "xe"        # xe | wxe | cst
+    # CST sub-switches (reference CST_* Makefile targets):
+    cst_baseline: str = "greedy"  # greedy (SCST/CST_MS_Greedy) | scb (CST_MS_SCB) | none (CST_GT_None)
+    cst_num_samples: int = 20     # multinomial rollouts per video (CST_MS)
+    cst_use_gt: bool = False      # CST_GT_None: "samples" are the GT captions
+    sample_temperature: float = 1.0
+
+    optimizer: str = "adam"
+    learning_rate: float = 2e-4
+    lr_decay: float = 0.5         # multiplicative decay factor
+    lr_decay_every: int = 3       # epochs between decays (0 = off)
+    grad_clip: float = 10.0       # global-norm clip (0 = off)
+    weight_decay: float = 0.0
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    max_epochs: int = 50
+    max_patience: int = 5         # early stop on val CIDEr
+    eval_every: int = 1           # epochs between val language evals
+    save_checkpoint_every: int = 1
+    checkpoint_dir: str = "checkpoints"
+    start_from: str = ""          # warm-start checkpoint (XE -> WXE -> CST staging)
+    seed: int = 213
+
+    # Parallelism over the device mesh (reference: .cuda()/DataParallel only).
+    mesh_shape: Dict[str, int] = field(default_factory=lambda: {"data": -1, "model": 1})
+    remat: bool = False           # jax.checkpoint the decoder scan
+    nan_check: bool = False       # debug nan-guard on losses/grads
+    profile_dir: str = ""         # jax.profiler trace output ("" = off)
+    log_every: int = 20           # steps between loss log lines
+    history_file: str = "history.json"
+
+
+@dataclass
+class EvalConfig:
+    """Decoding + metric suite — reference ``sample.py`` / ``test.py``."""
+
+    beam_size: int = 5
+    max_decode_len: int = 30
+    length_normalize: bool = True   # divide beam logprob by length at finalize
+    metrics: List[str] = field(
+        default_factory=lambda: ["Bleu_4", "METEOR", "ROUGE_L", "CIDEr"]
+    )
+    eval_split: str = "test"
+    out_dir: str = "eval_out"
+
+
+@dataclass
+class Config:
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    train: TrainConfig = field(default_factory=TrainConfig)
+    eval: EvalConfig = field(default_factory=EvalConfig)
+    name: str = "default"
+
+    # ------------------------------------------------------------------ io
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    def to_json(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "Config":
+        def build(tp, sub):
+            fields = {f.name: f for f in dataclasses.fields(tp)}
+            kwargs = {}
+            for k, v in sub.items():
+                if k not in fields:
+                    raise KeyError(f"unknown config key {tp.__name__}.{k}")
+                kwargs[k] = v
+            return tp(**kwargs)
+
+        return cls(
+            data=build(DataConfig, d.get("data", {})),
+            model=build(ModelConfig, d.get("model", {})),
+            train=build(TrainConfig, d.get("train", {})),
+            eval=build(EvalConfig, d.get("eval", {})),
+            name=d.get("name", "default"),
+        )
+
+    @classmethod
+    def from_json(cls, path: str) -> "Config":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def replace(self, **kv) -> "Config":
+        """dotted-path override: replace(**{"train.learning_rate": 1e-4})."""
+        d = self.to_dict()
+        for k, v in kv.items():
+            cur = d
+            parts = k.split(".")
+            for p in parts[:-1]:
+                cur = cur[p]
+            if parts[-1] not in cur:
+                raise KeyError(f"unknown config key {k}")
+            cur[parts[-1]] = v
+        d["name"] = d.get("name", self.name)
+        return Config.from_dict(d)
+
+
+# --------------------------------------------------------------------------
+# Presets — the five driver acceptance configs (BASELINE.json:6-12), plus a
+# CPU-runnable synthetic smoke config used by tests and CI.
+# --------------------------------------------------------------------------
+
+def _preset_msvd_xe() -> Config:
+    """1) MSVD, ResNet-152 feats only, XE loss, 1-layer LSTM-512 (tiny)."""
+    c = Config(name="msvd_resnet_xe")
+    c.data.dataset = "msvd"
+    c.data.feature_modalities = ["resnet"]
+    c.data.feature_dims = {"resnet": 2048}
+    c.data.seq_per_img = 17
+    c.model.num_layers = 1
+    c.model.rnn_size = 512
+    c.train.train_mode = "xe"
+    return c
+
+
+def _preset_msrvtt_xe() -> Config:
+    """2) MSR-VTT, ResNet-152 + C3D feats, XE-loss pretrain."""
+    c = Config(name="msrvtt_resnet_c3d_xe")
+    c.data.dataset = "msrvtt"
+    c.data.feature_modalities = ["resnet", "c3d"]
+    c.data.feature_dims = {"resnet": 2048, "c3d": 4096}
+    c.data.seq_per_img = 20
+    c.train.train_mode = "xe"
+    return c
+
+
+def _preset_msrvtt_wxe_cst_gt() -> Config:
+    """3) MSR-VTT, WXE warm-start -> CST_GT_None (GT samples, consensus weights)."""
+    c = _preset_msrvtt_xe()
+    c.name = "msrvtt_wxe_cst_gt_none"
+    c.train.train_mode = "wxe"
+    c.train.cst_baseline = "none"
+    c.train.cst_use_gt = True
+    c.train.learning_rate = 1e-4
+    c.train.start_from = "checkpoints/msrvtt_resnet_c3d_xe/best"
+    return c
+
+
+def _preset_msrvtt_cst_ms() -> Config:
+    """4) MSR-VTT, CST_MS multi-sample consensus (20-ref weighted CIDEr)."""
+    c = _preset_msrvtt_xe()
+    c.name = "msrvtt_cst_ms_scb"
+    c.train.train_mode = "cst"
+    c.train.cst_baseline = "scb"
+    c.train.cst_num_samples = 20
+    c.train.learning_rate = 1e-4
+    c.train.start_from = "checkpoints/msrvtt_wxe_cst_gt_none/best"
+    return c
+
+
+def _preset_msrvtt_eval() -> Config:
+    """5) MSR-VTT test eval, beam=5, full BLEU/METEOR/ROUGE/CIDEr suite."""
+    c = _preset_msrvtt_xe()
+    c.name = "msrvtt_eval_beam5"
+    c.eval.beam_size = 5
+    c.eval.eval_split = "test"
+    return c
+
+
+def _preset_synthetic_smoke() -> Config:
+    """CPU-runnable synthetic tiny config (tests / CI / integration)."""
+    c = Config(name="synthetic_smoke")
+    c.data.dataset = "synthetic"
+    c.data.feature_modalities = ["resnet"]
+    c.data.feature_dims = {"resnet": 64}
+    c.data.batch_size = 8
+    c.data.seq_per_img = 3
+    c.data.max_seq_len = 12
+    c.data.max_frames = 6
+    c.model.rnn_size = 32
+    c.model.input_encoding_size = 32
+    c.model.att_hidden_size = 32
+    c.model.drop_prob = 0.0
+    c.model.compute_dtype = "float32"
+    c.train.max_epochs = 3
+    c.train.log_every = 5
+    c.eval.beam_size = 3
+    c.eval.max_decode_len = 12
+    return c
+
+
+PRESETS = {
+    "msvd_resnet_xe": _preset_msvd_xe,
+    "msrvtt_resnet_c3d_xe": _preset_msrvtt_xe,
+    "msrvtt_wxe_cst_gt_none": _preset_msrvtt_wxe_cst_gt,
+    "msrvtt_cst_ms_scb": _preset_msrvtt_cst_ms,
+    "msrvtt_eval_beam5": _preset_msrvtt_eval,
+    "synthetic_smoke": _preset_synthetic_smoke,
+}
+
+
+def get_preset(name: str) -> Config:
+    if name not in PRESETS:
+        raise KeyError(f"unknown preset {name!r}; have {sorted(PRESETS)}")
+    return PRESETS[name]()
+
+
+# --------------------------------------------------------------------------
+# argparse bridge — CLI parity with the reference's `python train.py <flags>`.
+# Any dataclass field is addressable as --section.field (e.g. --train.learning_rate).
+# --------------------------------------------------------------------------
+
+def _add_section(parser: argparse.ArgumentParser, section: str, tp) -> None:
+    for f in dataclasses.fields(tp):
+        flag = f"--{section}.{f.name}"
+        if f.type in ("bool", bool):
+            parser.add_argument(flag, type=lambda s: s.lower() in ("1", "true", "yes"),
+                                default=None)
+        elif f.type in ("int", int):
+            parser.add_argument(flag, type=int, default=None)
+        elif f.type in ("float", float):
+            parser.add_argument(flag, type=float, default=None)
+        elif f.type in ("str", str):
+            parser.add_argument(flag, type=str, default=None)
+        else:  # dict/list fields take JSON literals
+            parser.add_argument(flag, type=json.loads, default=None)
+
+
+def parse_cli(argv: Optional[Sequence[str]] = None) -> Config:
+    """Build a Config from `--preset NAME` / `--config FILE` plus overrides."""
+    parser = argparse.ArgumentParser("cst_captioning_tpu")
+    parser.add_argument("--preset", type=str, default=None)
+    parser.add_argument("--config", type=str, default=None, help="JSON config file")
+    for section, tp in (("data", DataConfig), ("model", ModelConfig),
+                        ("train", TrainConfig), ("eval", EvalConfig)):
+        _add_section(parser, section, tp)
+    args = parser.parse_args(argv)
+
+    if args.config:
+        cfg = Config.from_json(args.config)
+    elif args.preset:
+        cfg = get_preset(args.preset)
+    else:
+        cfg = Config()
+
+    overrides = {
+        k: v for k, v in vars(args).items()
+        if v is not None and k not in ("preset", "config")
+    }
+    if overrides:
+        cfg = cfg.replace(**overrides)
+    return cfg

@@ -1,0 +1,19 @@
+"""Test configuration: force an 8-device virtual CPU platform BEFORE jax
+initializes, so distributed/mesh tests run without TPU hardware (SURVEY.md §4
+"Distributed" strategy)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng_seed():
+    return 213

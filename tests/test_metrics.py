@@ -1,0 +1,190 @@
+"""Golden tests for BLEU / ROUGE-L / CIDEr-D / METEOR-lite (SURVEY.md §4:
+"CiderD golden scores vs hand-cooked tiny corpus")."""
+
+import math
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.metrics.bleu import Bleu
+from cst_captioning_tpu.metrics.cider import (
+    Cider,
+    CiderD,
+    ciderd_score_cooked,
+    compute_doc_freq,
+    precook,
+    save_df,
+)
+from cst_captioning_tpu.metrics.meteor import MeteorLite
+from cst_captioning_tpu.metrics.rouge import Rouge, _lcs_len
+from cst_captioning_tpu.metrics.evaluator import language_eval
+
+
+GTS = {
+    "v1": ["a man is playing a guitar", "a man plays a guitar",
+           "someone is playing music"],
+    "v2": ["a dog runs in the park", "the dog is running outside",
+           "a dog runs around"],
+    "v3": ["a woman is cooking food", "a woman cooks in a kitchen",
+           "someone is cooking a meal"],
+}
+RES_PERFECT = {"v1": ["a man is playing a guitar"],
+               "v2": ["a dog runs in the park"],
+               "v3": ["a woman is cooking food"]}
+RES_PARTIAL = {"v1": ["a man is playing music"],
+               "v2": ["a cat sleeps on the sofa"],
+               "v3": ["a woman is cooking food"]}
+
+
+# ------------------------------------------------------------------- BLEU
+
+def test_bleu_perfect():
+    scores, seg = Bleu(4).compute_score(GTS, RES_PERFECT)
+    assert all(abs(s - 1.0) < 1e-6 for s in scores)
+    assert len(seg[3]) == 3
+
+
+def test_bleu_hand_computed_unigram():
+    gts = {"a": ["the cat sat on the mat"]}
+    res = {"a": ["the cat the cat"]}
+    scores, _ = Bleu(1).compute_score(gts, res)
+    # clipped unigram matches: "the"x2, "cat"x1 -> 3/4; BP=exp(1-6/4)
+    assert scores[0] == pytest.approx(0.75 * math.exp(1 - 6 / 4), rel=1e-6)
+
+
+def test_bleu_order():
+    s_good, _ = Bleu(4).compute_score(GTS, RES_PERFECT)
+    s_bad, _ = Bleu(4).compute_score(GTS, RES_PARTIAL)
+    assert s_good[3] > s_bad[3]
+
+
+# ---------------------------------------------------------------- ROUGE-L
+
+def test_lcs():
+    assert _lcs_len("abcde", "ace") == 3
+    assert _lcs_len([], "abc") == 0
+
+
+def test_rouge_perfect():
+    score, seg = Rouge().compute_score(GTS, RES_PERFECT)
+    assert score == pytest.approx(1.0)
+    assert seg.shape == (3,)
+
+
+def test_rouge_hand_computed():
+    gts = {"a": ["the cat sat on the mat"]}
+    res = {"a": ["the cat on the mat"]}
+    # LCS=5, P=5/5=1, R=5/6; F = (1+b^2)PR/(R+b^2 P), beta=1.2
+    p, r, b = 1.0, 5 / 6, 1.2
+    expect = (1 + b * b) * p * r / (r + b * b * p)
+    score, _ = Rouge().compute_score(gts, res)
+    assert score == pytest.approx(expect, rel=1e-9)
+
+
+# ------------------------------------------------------------------ CIDEr
+
+def test_cider_perfect_greater_than_partial():
+    d = CiderD()
+    s_good, _ = d.compute_score(GTS, RES_PERFECT)
+    s_bad, _ = d.compute_score(GTS, RES_PARTIAL)
+    assert s_good > s_bad > 0
+
+
+def test_ciderd_identity_score_single_ngram_corpus():
+    """Hand-checkable case: every video has one ref; candidate == ref.
+
+    With 3 distinct single-sentence refs, cosine similarity per order is 1
+    wherever the candidate has ngrams with nonzero idf, giving score 10 per
+    matching order; orders with all-zero idf vectors contribute 0.
+    """
+    gts = {"a": ["x y z"], "b": ["p q r"], "c": ["m n o"]}
+    res = {"a": ["x y z"], "b": ["p q r"], "c": ["m n o"]}
+    score, seg = CiderD().compute_score(gts, res)
+    # all ngrams unique to each video: df=1, idf=log(3); norms match exactly
+    # orders 1..3 exist (len-3 sentence has no 4-gram) -> mean over 4 orders
+    assert seg[0] == pytest.approx(10.0 * 3 / 4, rel=1e-6)
+
+
+def test_ciderd_length_penalty():
+    gts = {"a": ["a b c d e f g h"], "b": ["z z z z"]}
+    res_same = {"a": ["a b c d e f g h"], "b": ["z z z z"]}
+    res_short = {"a": ["a b c"], "b": ["z z z z"]}
+    s_same, seg_same = CiderD().compute_score(gts, res_same)
+    s_short, seg_short = CiderD().compute_score(gts, res_short)
+    assert seg_same[0] > seg_short[0]
+
+
+def test_cider_vs_ciderd_differ_on_repeats():
+    # plain CIDEr doesn't clip counts; repeating a rare ngram inflates it.
+    gts = {"a": ["a b a b"], "b": ["c d e f"]}
+    res = {"a": ["a b a b a b a b"], "b": ["c d e f"]}
+    c, _ = Cider().compute_score(gts, res)
+    cd, _ = CiderD().compute_score(gts, res)
+    assert c != pytest.approx(cd)
+
+
+def test_precook_counts():
+    c = precook("a b a".split())
+    assert c[("a",)] == 2 and c[("b",)] == 1
+    assert c[("a", "b")] == 1 and c[("b", "a")] == 1
+    assert c[("a", "b", "a")] == 1
+
+
+def test_doc_freq():
+    crefs = [[precook("a b".split()), precook("a c".split())],
+             [precook("a d".split())]]
+    df = compute_doc_freq(crefs)
+    assert df[("a",)] == 2  # appears in both videos' ref sets
+    assert df[("b",)] == 1
+
+
+def test_saved_df_roundtrip(tmp_path):
+    path = str(tmp_path / "df.json")
+    save_df(GTS, path)
+    d1 = CiderD(df_mode=path)
+    s1, _ = d1.compute_score(GTS, RES_PARTIAL)
+    s2, _ = CiderD().compute_score(GTS, RES_PARTIAL)
+    assert s1 == pytest.approx(s2, rel=1e-9)
+
+
+def test_cooked_scoring_matches_string_path():
+    """The RL hot-path entry (cooked counters) must agree with the string API."""
+    crefs = [[precook(c.split()) for c in caps] for caps in
+             (GTS[k] for k in sorted(GTS))]
+    df = compute_doc_freq(crefs)
+    log_n = math.log(len(crefs))
+    keys = sorted(GTS)
+    for i, k in enumerate(keys):
+        cooked = ciderd_score_cooked(precook(RES_PARTIAL[k][0].split()),
+                                     crefs[i], df, log_n)
+        _, seg = CiderD().compute_score(GTS, RES_PARTIAL)
+        assert cooked == pytest.approx(seg[i], rel=1e-9)
+
+
+# ----------------------------------------------------------------- METEOR
+
+def test_meteor_lite_orders_correctly():
+    m = MeteorLite()
+    s_good, _ = m.compute_score(GTS, RES_PERFECT)
+    s_bad, _ = m.compute_score(GTS, RES_PARTIAL)
+    assert s_good > s_bad > 0
+
+
+def test_meteor_stem_match():
+    m = MeteorLite()
+    gts = {"a": ["a man is running fast"]}
+    res_stem = {"a": ["a man is run fast"]}     # "run" stem-matches "running"
+    res_miss = {"a": ["a man is xyz fast"]}
+    s_stem, _ = m.compute_score(gts, res_stem)
+    s_miss, _ = m.compute_score(gts, res_miss)
+    assert s_stem > s_miss
+
+
+# -------------------------------------------------------------- evaluator
+
+def test_language_eval_suite():
+    out = language_eval(GTS, RES_PARTIAL)
+    for k in ("Bleu_1", "Bleu_4", "METEOR", "ROUGE_L", "CIDEr"):
+        assert k in out
+        assert 0.0 <= float(out[k]) <= 10.0 * (k == "CIDEr") + 1.0 or k == "CIDEr"
+    assert out["Bleu_1"] >= out["Bleu_4"]

@@ -1,0 +1,44 @@
+"""Porter stemmer golden cases (from Porter's published vocabulary examples)."""
+
+import pytest
+
+from cst_captioning_tpu.metrics.porter import porter_stem
+
+CASES = [
+    ("caresses", "caress"), ("ponies", "poni"), ("caress", "caress"),
+    ("cats", "cat"), ("feed", "feed"), ("agreed", "agre"),
+    ("plastered", "plaster"), ("bled", "bled"), ("motoring", "motor"),
+    ("sing", "sing"), ("conflated", "conflat"), ("troubled", "troubl"),
+    ("sized", "size"), ("hopping", "hop"), ("tanned", "tan"),
+    ("falling", "fall"), ("hissing", "hiss"), ("fizzed", "fizz"),
+    ("failing", "fail"), ("filing", "file"), ("happy", "happi"),
+    ("sky", "sky"), ("relational", "relat"), ("conditional", "condit"),
+    ("rational", "ration"), ("valenci", "valenc"), ("digitizer", "digit"),
+    ("conformabli", "conform"), ("radicalli", "radic"),
+    ("differentli", "differ"), ("vileli", "vile"), ("analogousli", "analog"),
+    ("vietnamization", "vietnam"), ("predication", "predic"),
+    ("operator", "oper"), ("feudalism", "feudal"),
+    ("decisiveness", "decis"), ("hopefulness", "hope"),
+    ("callousness", "callous"), ("formaliti", "formal"),
+    ("sensitiviti", "sensit"), ("sensibiliti", "sensibl"),
+    ("triplicate", "triplic"), ("formative", "form"), ("formalize", "formal"),
+    ("electriciti", "electr"), ("electrical", "electr"), ("hopeful", "hope"),
+    ("goodness", "good"), ("revival", "reviv"), ("allowance", "allow"),
+    ("inference", "infer"), ("airliner", "airlin"), ("gyroscopic", "gyroscop"),
+    ("adjustable", "adjust"), ("defensible", "defens"), ("irritant", "irrit"),
+    ("replacement", "replac"), ("adjustment", "adjust"), ("dependent", "depend"),
+    ("adoption", "adopt"), ("homologou", "homolog"), ("communism", "commun"),
+    ("activate", "activ"), ("angulariti", "angular"), ("homologous", "homolog"),
+    ("effective", "effect"), ("bowdlerize", "bowdler"),
+    ("probate", "probat"), ("rate", "rate"), ("cease", "ceas"),
+    ("controll", "control"), ("roll", "roll"),
+    # caption-domain words
+    # original-spec Porter applies (*v*) Y -> I, so play -> plai
+    ("running", "run"), ("playing", "plai"), ("plays", "plai"),
+    ("cooking", "cook"), ("jumps", "jump"), ("dancing", "danc"),
+]
+
+
+@pytest.mark.parametrize("word,stem", CASES)
+def test_porter(word, stem):
+    assert porter_stem(word) == stem

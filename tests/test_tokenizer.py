@@ -1,0 +1,58 @@
+"""Golden tests for the PTB tokenization pipeline (SURVEY.md §4 "tokenizer
+parity"): outputs must match what coco-caption's Java PTBTokenizer + punct
+strip would produce for caption-style text."""
+
+from cst_captioning_tpu.metrics.tokenizer import (
+    ptb_tokenize,
+    ptb_word_tokenize,
+    tokenize_corpus,
+)
+
+
+def test_basic_lowercase_and_punct_strip():
+    assert ptb_tokenize("A man is Playing a Guitar.") == \
+        ["a", "man", "is", "playing", "a", "guitar"]
+
+
+def test_commas_and_final_period():
+    assert ptb_tokenize("a dog, a cat, and a bird.") == \
+        ["a", "dog", "a", "cat", "and", "a", "bird"]
+
+
+def test_contractions_split():
+    # CoreNLP splits "doesn't" -> "does" + "n't"; punctuation strip keeps both.
+    assert ptb_tokenize("The dog doesn't run") == ["the", "dog", "does", "n't", "run"]
+    assert ptb_tokenize("he's running") == ["he", "'s", "running"]
+    assert ptb_tokenize("they're here") == ["they", "'re", "here"]
+
+
+def test_question_exclamation():
+    assert ptb_tokenize("is it real?!") == ["is", "it", "real"]
+
+
+def test_brackets_normalized_then_stripped():
+    # ( ) -> -LRB- -RRB- which are in the punctuation strip list.
+    assert ptb_word_tokenize("a (small) dog")[1] == "-LRB-"
+    assert ptb_tokenize("a (small) dog") == ["a", "small", "dog"]
+
+
+def test_ellipsis_and_dashes_stripped():
+    assert ptb_tokenize("wait... what -- no") == ["wait", "what", "no"]
+
+
+def test_quotes_stripped():
+    assert ptb_tokenize('he said "hello world"') == ["he", "said", "hello", "world"]
+
+
+def test_numbers_kept():
+    assert ptb_tokenize("2 men play 3 games") == ["2", "men", "play", "3", "games"]
+
+
+def test_interior_period_not_split():
+    # PTB only splits sentence-final periods; "u.s." style stays intact.
+    assert ptb_tokenize("the u.s. team wins") == ["the", "u.s.", "team", "wins"]
+
+
+def test_tokenize_corpus_shape():
+    out = tokenize_corpus({"v1": ["A Dog runs.", "a CAT sits!"], "v2": ["Hi."]})
+    assert out == {"v1": ["a dog runs", "a cat sits"], "v2": ["hi"]}
