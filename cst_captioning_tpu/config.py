@@ -53,7 +53,7 @@ class ModelConfig:
     rnn_size: int = 512           # LSTM hidden size
     num_layers: int = 1           # 1-2 layer LSTM
     input_encoding_size: int = 512  # word/feature embedding dim
-    feature_fusion: str = "meanpool"  # meanpool | attention | concat
+    feature_fusion: str = "meanpool"  # meanpool | attention
     att_hidden_size: int = 512    # temporal-attention MLP width
     drop_prob: float = 0.5        # dropout on LM input/output
     scheduled_sampling_start: int = -1   # epoch to start ss (-1 = off)

@@ -1,0 +1,19 @@
+"""Model layer: multi-modal feature fusion + LSTM caption decoder.
+
+Rebuilds the capabilities of the reference's ``model.py`` (SURVEY.md §2:
+``CaptionModel`` — per-modality projection, mean-pool or temporal soft
+attention fusion, 1-2 layer LSTM-512, vocab softmax; teacher-forced
+``forward``; autoregressive ``sample``) as a Flax module whose time loops
+are ``lax.scan`` and whose matmuls are batched for the MXU.
+"""
+
+from cst_captioning_tpu.models.captioner import (  # noqa: F401
+    CaptionModel,
+    SampleOutput,
+    PAD_ID,
+    BOS_ID,
+    EOS_ID,
+    UNK_ID,
+    NUM_SPECIAL_TOKENS,
+    model_from_config,
+)

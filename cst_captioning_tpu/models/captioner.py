@@ -1,0 +1,423 @@
+"""Multi-modal LSTM caption decoder, TPU-first.
+
+Reference behavior being rebuilt (SURVEY.md §2 "Caption model", §3.1-3.2):
+``model.py``'s ``CaptionModel`` embeds pre-extracted per-modality video
+features (linear projection each), fuses them by temporal mean-pooling or
+per-step temporal soft attention, runs a 1-2 layer LSTM-512 decoder with a
+vocab softmax head, and exposes teacher-forced ``forward`` (with scheduled
+sampling) plus autoregressive ``sample`` (greedy / multinomial with
+temperature) returning sequences and per-token log-probabilities.
+
+TPU-first design decisions (deliberately NOT a torch translation):
+* The per-timestep Python loop (reference hot loop #1) is ``lax.scan``; the
+  whole forward is one traced graph.
+* Parameters are created in ``setup`` as raw arrays (``self.param``) and the
+  scan bodies are pure closures over them — no module calls inside scan, so
+  the same step function serves teacher forcing, sampling, and beam search
+  (``init_decode`` / ``decode_one``) without retracing linen machinery.
+* The vocab projection is applied to the whole (B, T, H) hidden sequence
+  after the scan — one large MXU matmul instead of T small ones.
+* Activations run in ``compute_dtype`` (bfloat16 by default); LSTM cell
+  state and all softmax/loss math stay float32.
+* Fixed shapes everywhere: ``sample`` runs exactly ``max_len`` steps with a
+  finished-mask; there is no data-dependent Python control flow.
+
+Token id convention (framework-wide): 0=PAD, 1=BOS, 2=EOS, 3=UNK, words
+from 4.  PAD and EOS both terminate a sequence when sampled; the end token
+slot is included in loss masks, padding after it is not.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from cst_captioning_tpu.ops.rnn import (
+    LSTMWeights,
+    lstm_bias_init,
+    lstm_kernel_init,
+    lstm_step,
+)
+
+PAD_ID = 0
+BOS_ID = 1
+EOS_ID = 2
+UNK_ID = 3
+NUM_SPECIAL_TOKENS = 4
+
+
+class SampleOutput(NamedTuple):
+    tokens: jax.Array    # (B, L) int32 — sampled ids, PAD after the end token
+    logprobs: jax.Array  # (B, L) float32 — log p of each sampled token (0 after end)
+    mask: jax.Array      # (B, L) float32 — 1 up to and including the end token
+
+
+class DecodeState(NamedTuple):
+    """Autoregressive decoder carry: per-layer (h, c)."""
+
+    h: jax.Array  # (num_layers, B, H) compute dtype
+    c: jax.Array  # (num_layers, B, H) float32
+
+
+class DecodeCache(NamedTuple):
+    """Per-video tensors fixed across decode steps."""
+
+    ctx_static: jax.Array  # (B, E) mean-pooled fused context (meanpool mode)
+    att_vals: jax.Array    # (B, F, E) projected frame features (attention mode)
+    att_proj: jax.Array    # (B, F, A) pre-projected attention keys
+    att_mask: jax.Array    # (B, F) frame validity
+    cat_emb: jax.Array     # (B, C) category embedding ((B, 0) when unused)
+
+
+def _uniform_init(scale: float):
+    def init(key, shape, dtype):
+        return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+    return init
+
+
+class CaptionModel(nn.Module):
+    """See module docstring.  Field semantics follow ``ModelConfig``."""
+
+    vocab_size: int
+    rnn_size: int = 512
+    num_layers: int = 1
+    embed_size: int = 512
+    fusion: str = "meanpool"            # meanpool | attention
+    att_hidden_size: int = 512
+    drop_prob: float = 0.5
+    modalities: Tuple[str, ...] = ("resnet",)
+    feature_dims: Tuple[int, ...] = (2048,)
+    use_category: bool = False
+    num_categories: int = 20
+    category_embed_size: int = 64
+    compute_dtype: str = "bfloat16"
+    param_dtype: str = "float32"
+
+    # ---------------------------------------------------------------- setup
+    def setup(self):
+        assert len(self.modalities) == len(self.feature_dims)
+        pdt = jnp.dtype(self.param_dtype)
+        E, H, A, V = (
+            self.embed_size,
+            self.rnn_size,
+            self.att_hidden_size,
+            self.vocab_size,
+        )
+        self.word_embed = self.param(
+            "word_embed", _uniform_init(0.1), (V, E), pdt
+        )
+        self.proj_w = [
+            self.param(f"proj_{m}_w", nn.initializers.glorot_uniform(), (d, E), pdt)
+            for m, d in zip(self.modalities, self.feature_dims)
+        ]
+        self.proj_b = [
+            self.param(f"proj_{m}_b", nn.initializers.zeros_init(), (E,), pdt)
+            for m in self.modalities
+        ]
+        if self.fusion == "attention":
+            self.att_wf = self.param(
+                "att_wf", nn.initializers.glorot_uniform(), (E, A), pdt
+            )
+            self.att_wh = self.param(
+                "att_wh", nn.initializers.glorot_uniform(), (H, A), pdt
+            )
+            self.att_b = self.param("att_b", nn.initializers.zeros_init(), (A,), pdt)
+            self.att_v = self.param(
+                "att_v", nn.initializers.glorot_uniform(), (A, 1), pdt
+            )
+        if self.use_category:
+            self.cat_embed = self.param(
+                "cat_embed",
+                _uniform_init(0.1),
+                (self.num_categories, self.category_embed_size),
+                pdt,
+            )
+        in_dim = E + E + (self.category_embed_size if self.use_category else 0)
+        lstm = []
+        for layer in range(self.num_layers):
+            d_in = in_dim if layer == 0 else H
+            w = self.param(
+                f"lstm{layer}_w", lstm_kernel_init, (d_in + H, 4 * H), pdt
+            )
+            b = self.param(f"lstm{layer}_b", lstm_bias_init, (4 * H,), pdt)
+            lstm.append(LSTMWeights(w=w, b=b))
+        self.lstm = lstm
+        self.logit_w = self.param(
+            "logit_w", nn.initializers.glorot_uniform(), (H, V), pdt
+        )
+        self.logit_b = self.param("logit_b", nn.initializers.zeros_init(), (V,), pdt)
+
+    # ------------------------------------------------------------- encoding
+    def _encode(
+        self,
+        feats: Dict[str, jax.Array],
+        feat_masks: Dict[str, jax.Array],
+        category: Optional[jax.Array],
+    ) -> DecodeCache:
+        """Project each modality to the shared embed dim and build the cache.
+
+        ``feats[m]``: (B, F_m, D_m); ``feat_masks[m]``: (B, F_m) in {0,1}.
+        Mean-pool context averages masked frames per modality, then averages
+        modalities (keeps scale independent of modality count).  Attention
+        values concatenate all modalities' frames along time.
+        """
+        cdt = jnp.dtype(self.compute_dtype)
+        vals, masks, means = [], [], []
+        for i, m in enumerate(self.modalities):
+            f = feats[m].astype(cdt)
+            v = f @ self.proj_w[i].astype(cdt) + self.proj_b[i].astype(cdt)
+            fm = feat_masks[m].astype(jnp.float32)
+            denom = jnp.maximum(fm.sum(-1, keepdims=True), 1.0)
+            mean = (v.astype(jnp.float32) * fm[..., None]).sum(1) / denom
+            vals.append(v)
+            masks.append(fm)
+            means.append(mean)
+        ctx_static = (sum(means) / len(means)).astype(cdt)
+        att_vals = jnp.concatenate(vals, axis=1)
+        att_mask = jnp.concatenate(masks, axis=1)
+        if self.fusion == "attention":
+            att_proj = att_vals @ self.att_wf.astype(cdt) + self.att_b.astype(cdt)
+        else:
+            att_proj = jnp.zeros(att_vals.shape[:2] + (0,), cdt)
+        if self.use_category:
+            if category is None:
+                raise ValueError(
+                    "model was built with use_category=True but no `category` "
+                    "ids were passed — a zeroed embedding would silently "
+                    "degrade decoding"
+                )
+            cat_emb = self.cat_embed.astype(cdt)[category]
+        else:
+            cat_emb = jnp.zeros((att_vals.shape[0], 0), cdt)
+        return DecodeCache(
+            ctx_static=ctx_static,
+            att_vals=att_vals,
+            att_proj=att_proj,
+            att_mask=att_mask,
+            cat_emb=cat_emb,
+        )
+
+    def _context(self, cache: DecodeCache, h_top: jax.Array) -> jax.Array:
+        """Per-step fused context: static mean-pool, or soft attention
+        queried by the previous top-layer hidden state (Bahdanau MLP —
+        reference ``model.py`` attention, SURVEY.md §2)."""
+        if self.fusion != "attention":
+            return cache.ctx_static
+        cdt = jnp.dtype(self.compute_dtype)
+        q = h_top.astype(cdt) @ self.att_wh.astype(cdt)  # (B, A)
+        s = jnp.tanh(cache.att_proj + q[:, None, :]) @ self.att_v.astype(cdt)
+        s = s[..., 0].astype(jnp.float32)  # (B, F)
+        s = jnp.where(cache.att_mask > 0, s, -1e30)
+        a = jax.nn.softmax(s, axis=-1)
+        ctx = jnp.einsum("bf,bfe->be", a.astype(cdt), cache.att_vals)
+        return ctx
+
+    # ------------------------------------------------------------ step core
+    def _step(
+        self, state: DecodeState, cache: DecodeCache, tokens: jax.Array
+    ) -> Tuple[DecodeState, jax.Array]:
+        """One decoder step: embed ``tokens`` (B,), fuse context, run the
+        LSTM stack.  Returns new state and the top hidden (B, H) — the vocab
+        projection is applied by the caller (batched over time in forward,
+        per-step in decode)."""
+        cdt = jnp.dtype(self.compute_dtype)
+        emb = self.word_embed.astype(cdt)[tokens]
+        ctx = self._context(cache, state.h[-1])
+        x = jnp.concatenate([emb, ctx.astype(cdt), cache.cat_emb], axis=-1)
+        hs, cs = [], []
+        for layer in range(self.num_layers):
+            h_new, c_new = lstm_step(
+                self.lstm[layer],
+                x,
+                state.h[layer],
+                state.c[layer],
+                compute_dtype=cdt,
+            )
+            hs.append(h_new)
+            cs.append(c_new)
+            x = h_new
+        return DecodeState(h=jnp.stack(hs), c=jnp.stack(cs)), x
+
+    def _init_state(self, batch: int) -> DecodeState:
+        cdt = jnp.dtype(self.compute_dtype)
+        return DecodeState(
+            h=jnp.zeros((self.num_layers, batch, self.rnn_size), cdt),
+            c=jnp.zeros((self.num_layers, batch, self.rnn_size), jnp.float32),
+        )
+
+    def _logits(self, h: jax.Array) -> jax.Array:
+        cdt = jnp.dtype(self.compute_dtype)
+        return (
+            h.astype(cdt) @ self.logit_w.astype(cdt) + self.logit_b.astype(cdt)
+        ).astype(jnp.float32)
+
+    # --------------------------------------------------------------- forward
+    def __call__(
+        self,
+        feats: Dict[str, jax.Array],
+        feat_masks: Dict[str, jax.Array],
+        input_ids: jax.Array,
+        *,
+        category: Optional[jax.Array] = None,
+        ss_prob: jax.Array | float = 0.0,
+        deterministic: bool = True,
+        rng: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Teacher-forced forward.  ``input_ids`` (B, T) starts with BOS;
+        returns logits (B, T, V) predicting ``input_ids`` shifted left.
+
+        ``ss_prob`` enables scheduled sampling (reference ``opts.py``
+        scheduled_sampling_*): with that probability per token, the input is
+        the model's own sample from the previous step instead of the GT.
+        """
+        B, T = input_ids.shape
+        cache = self._encode(feats, feat_masks, category)
+        state0 = self._init_state(B)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        # Statically-zero ss_prob (the XE/eval hot path) takes a branch with
+        # no per-step vocab projection or sampling — the only logits matmul
+        # is the single batched one over (B, T, H) below.
+        use_ss = not (isinstance(ss_prob, float) and ss_prob == 0.0)
+
+        def step(carry, tok_t):
+            state, prev_sample, key = carry
+            if use_ss:
+                key, k_mix, k_samp = jax.random.split(key, 3)
+                use_sample = jax.random.bernoulli(
+                    k_mix, jnp.asarray(ss_prob, jnp.float32), (B,)
+                )
+                tok = jnp.where(use_sample, prev_sample, tok_t)
+            else:
+                tok = tok_t
+            state, h_top = self._step(state, cache, tok)
+            if use_ss:
+                sampled = jax.random.categorical(k_samp, self._logits(h_top))
+                prev_sample = sampled.astype(jnp.int32)
+            return (state, prev_sample, key), h_top
+
+        # At t=0 the input is BOS — never replaced (prev_sample init = column 0).
+        (_, _, _), h_seq = jax.lax.scan(
+            step,
+            (state0, input_ids[:, 0], rng),
+            jnp.swapaxes(input_ids, 0, 1),
+        )
+        h_seq = jnp.swapaxes(h_seq, 0, 1)  # (B, T, H)
+        if not deterministic and self.drop_prob > 0.0:
+            drop_rng = self.make_rng("dropout")
+            keep = 1.0 - self.drop_prob
+            mask = jax.random.bernoulli(drop_rng, keep, h_seq.shape)
+            h_seq = jnp.where(mask, h_seq / keep, 0.0).astype(h_seq.dtype)
+        return self._logits(h_seq)
+
+    # --------------------------------------------------------------- decode
+    def init_decode(
+        self,
+        feats: Dict[str, jax.Array],
+        feat_masks: Dict[str, jax.Array],
+        category: Optional[jax.Array] = None,
+    ) -> Tuple[DecodeState, DecodeCache]:
+        """Entry point for external decoders (beam search): encode once,
+        return (initial state, per-video cache)."""
+        some = feats[self.modalities[0]]
+        return self._init_state(some.shape[0]), self._encode(
+            feats, feat_masks, category
+        )
+
+    def decode_one(
+        self, state: DecodeState, cache: DecodeCache, tokens: jax.Array
+    ) -> Tuple[DecodeState, jax.Array]:
+        """One decode step → (new state, float32 log-probs (B, V))."""
+        state, h_top = self._step(state, cache, tokens)
+        return state, jax.nn.log_softmax(self._logits(h_top), axis=-1)
+
+    def sample(
+        self,
+        feats: Dict[str, jax.Array],
+        feat_masks: Dict[str, jax.Array],
+        *,
+        rng: Optional[jax.Array] = None,
+        category: Optional[jax.Array] = None,
+        max_len: int = 30,
+        greedy: bool = True,
+        temperature: float = 1.0,
+    ) -> SampleOutput:
+        """Autoregressive decode under ``jit``: exactly ``max_len`` steps,
+        finished sequences emit PAD with zero log-prob (fixed shapes — no
+        dynamic control flow).  ``greedy=True`` is the SCST baseline path;
+        ``greedy=False`` is the multinomial rollout (temperature-scaled),
+        with log-probs taken from the same scaled distribution the token was
+        drawn from, as REINFORCE requires.
+        """
+        state, cache = self.init_decode(feats, feat_masks, category)
+        B = state.h.shape[1]
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+
+        def step(carry, _):
+            state, tok, finished, key = carry
+            key, k = jax.random.split(key)
+            state, h_top = self._step(state, cache, tok)
+            logits = self._logits(h_top)
+            if greedy:
+                logp = jax.nn.log_softmax(logits, axis=-1)
+                nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
+            else:
+                scaled = logits / jnp.asarray(temperature, jnp.float32)
+                logp = jax.nn.log_softmax(scaled, axis=-1)
+                nxt = jax.random.categorical(k, scaled).astype(jnp.int32)
+            tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+            valid = ~finished                       # this slot is live
+            out_tok = jnp.where(valid, nxt, PAD_ID)
+            out_lp = jnp.where(valid, tok_lp, 0.0)
+            ended = (nxt == EOS_ID) | (nxt == PAD_ID)
+            finished = finished | ended
+            # Feed EOS (not raw PAD) back in so the next-step input embedding
+            # is well-defined even for finished rows.
+            feed = jnp.where(out_tok == PAD_ID, EOS_ID, out_tok)
+            return (state, feed, finished, key), (
+                out_tok,
+                out_lp,
+                valid.astype(jnp.float32),
+            )
+
+        bos = jnp.full((B,), BOS_ID, jnp.int32)
+        fin0 = jnp.zeros((B,), bool)
+        _, (toks, lps, mask) = jax.lax.scan(
+            step, (state, bos, fin0, rng), None, length=max_len
+        )
+        return SampleOutput(
+            tokens=jnp.swapaxes(toks, 0, 1),
+            logprobs=jnp.swapaxes(lps, 0, 1),
+            mask=jnp.swapaxes(mask, 0, 1),
+        )
+
+
+def model_from_config(cfg) -> CaptionModel:
+    """Build a CaptionModel from a ``Config`` (see ``config.py``)."""
+    m, d = cfg.model, cfg.data
+    if m.feature_fusion not in ("meanpool", "attention"):
+        raise ValueError(
+            f"unknown feature_fusion {m.feature_fusion!r}; "
+            "expected 'meanpool' or 'attention'"
+        )
+    return CaptionModel(
+        vocab_size=m.vocab_size,
+        rnn_size=m.rnn_size,
+        num_layers=m.num_layers,
+        embed_size=m.input_encoding_size,
+        fusion=m.feature_fusion,
+        att_hidden_size=m.att_hidden_size,
+        drop_prob=m.drop_prob,
+        modalities=tuple(d.feature_modalities),
+        feature_dims=tuple(d.feature_dims[k] for k in d.feature_modalities),
+        use_category=m.use_category,
+        num_categories=d.num_categories,
+        category_embed_size=m.category_embed_size,
+        compute_dtype=m.compute_dtype,
+        param_dtype=m.param_dtype,
+    )

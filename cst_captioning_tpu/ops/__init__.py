@@ -1,0 +1,14 @@
+"""TPU-native compute ops: LSTM cell math, losses, sampling primitives.
+
+These are pure functions over arrays (no module state) so they can be
+unit-tested against a torch-CPU oracle, swapped for Pallas kernels, and
+used identically from teacher-forced training, autoregressive sampling,
+and beam search.
+"""
+
+from cst_captioning_tpu.ops.rnn import lstm_step, LSTMWeights, init_lstm_weights  # noqa: F401
+from cst_captioning_tpu.ops.losses import (  # noqa: F401
+    masked_cross_entropy,
+    weighted_cross_entropy,
+    reward_criterion,
+)

@@ -1,0 +1,85 @@
+"""Training criteria — pure jnp, usable inside a jitted train step.
+
+Reference equivalents (SURVEY.md §2):
+* ``masked_cross_entropy``  — reference ``model.py`` ``CrossEntropyCriterion``:
+  token-level XE over the padded caption matrix, averaged over real tokens.
+* ``weighted_cross_entropy`` — WXE / "CST_GT_None": the same loss with each
+  caption's tokens scaled by that caption's CIDEr consensus weight.
+* ``reward_criterion`` — reference ``RewardCriterion``: REINFORCE
+  ``-(reward - baseline) * logprob * mask``, normalized by the mask sum.
+
+All reductions are in float32 regardless of activation dtype.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _token_logprobs(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """log p(target_t) per token. logits (B, T, V) float; targets (B, T) int."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+
+
+def masked_cross_entropy(
+    logits: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array,
+    *,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Mean negative log-likelihood over unmasked tokens.
+
+    ``mask`` is float/bool (B, T); padding tokens contribute nothing.
+    """
+    mask = mask.astype(jnp.float32)
+    nll = -_token_logprobs(logits, targets)
+    if label_smoothing > 0.0:
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        smooth = -jnp.mean(logp, axis=-1)
+        nll = (1.0 - label_smoothing) * nll + label_smoothing * smooth
+    return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def weighted_cross_entropy(
+    logits: jax.Array,
+    targets: jax.Array,
+    mask: jax.Array,
+    caption_weights: jax.Array,
+) -> jax.Array:
+    """WXE: per-caption consensus weight scales every token of that caption.
+
+    ``caption_weights`` is (B,) — the caption's CIDEr consensus against its
+    sibling references (reference prep pipeline, SURVEY.md §3.4).  The loss
+    normalizer is the *unweighted* mask sum, matching the reference's
+    behavior of re-weighting captions rather than re-normalizing: captions
+    with higher consensus simply contribute more gradient.
+    """
+    mask = mask.astype(jnp.float32)
+    nll = -_token_logprobs(logits, targets)
+    w = caption_weights.astype(jnp.float32)[:, None]
+    return jnp.sum(nll * mask * w) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def reward_criterion(
+    logprobs: jax.Array,
+    mask: jax.Array,
+    advantage: jax.Array,
+) -> jax.Array:
+    """Policy-gradient loss: ``-E[advantage * log p(sampled token)]``.
+
+    ``logprobs``  (B, T) — per-token log-probabilities of the *sampled*
+                  sequence (from the multinomial rollout).
+    ``mask``      (B, T) — 1 for tokens up to and including EOS.
+    ``advantage`` (B,)   — reward minus baseline (greedy / SCB / none),
+                  computed on host from CIDEr-D; treated as a constant
+                  (no gradient flows through it).
+    """
+    mask = mask.astype(jnp.float32)
+    adv = jax.lax.stop_gradient(advantage.astype(jnp.float32))[:, None]
+    loss = -logprobs.astype(jnp.float32) * adv * mask
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)

@@ -1,0 +1,85 @@
+"""LSTM cell math as pure array functions.
+
+The reference's decoder is a 1-2 layer LSTM-512 driven step-by-step from
+Python (reference ``model.py``, per SURVEY.md §2/§3: per-timestep unroll is
+hot loop #1).  On TPU the unroll becomes ``lax.scan`` over this cell; the
+cell itself is a single fused ``[x, h] @ W`` matmul that XLA tiles onto the
+MXU.  Gate order is (i, f, g, o) — the same as ``torch.nn.LSTMCell`` — so
+the torch-CPU oracle test can compare directly.
+
+``lstm_step`` is the swap point for the Pallas fused kernel
+(``ops/pallas_lstm.py``): same signature, same semantics.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LSTMWeights(NamedTuple):
+    """One layer's weights. ``w``: ((input_dim + hidden), 4*hidden), gates
+    ordered i|f|g|o along the last axis; ``b``: (4*hidden,)."""
+
+    w: jax.Array
+    b: jax.Array
+
+
+def lstm_kernel_init(key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+    """Uniform ±1/sqrt(hidden) over the fused ((in+hidden), 4*hidden) kernel.
+    Single source of truth for the gate layout's init (also used by the Flax
+    captioner and the Pallas fast path)."""
+    hidden = shape[-1] // 4
+    scale = 1.0 / float(hidden) ** 0.5
+    return jax.random.uniform(key, shape, dtype, -scale, scale)
+
+
+def lstm_bias_init(key: jax.Array, shape, dtype=jnp.float32) -> jax.Array:
+    """Zero bias with forget gate at 1.0 (standard gradient-flow trick).
+    Encodes the i|f|g|o layout's forget slice in one place."""
+    hidden = shape[-1] // 4
+    return jnp.zeros(shape, dtype).at[hidden : 2 * hidden].set(1.0)
+
+
+def init_lstm_weights(
+    rng: jax.Array, input_dim: int, hidden: int, dtype=jnp.float32
+) -> LSTMWeights:
+    k_w, k_b = jax.random.split(rng)
+    w = lstm_kernel_init(k_w, (input_dim + hidden, 4 * hidden), dtype)
+    b = lstm_bias_init(k_b, (4 * hidden,), dtype)
+    return LSTMWeights(w=w, b=b)
+
+
+def lstm_step(
+    weights: LSTMWeights,
+    x: jax.Array,
+    h: jax.Array,
+    c: jax.Array,
+    *,
+    compute_dtype=None,
+) -> Tuple[jax.Array, jax.Array]:
+    """One LSTM step: ``(h', c') = cell(x, (h, c))``.
+
+    A single concatenated matmul ``[x, h] @ w`` (one MXU-friendly GEMM per
+    layer per step) followed by elementwise gates, which XLA fuses into the
+    matmul epilogue.  The cell state ``c`` is kept in float32 even when
+    activations run in bfloat16 — the additive recurrence accumulates
+    rounding error otherwise.
+    """
+    hidden = h.shape[-1]
+    if compute_dtype is not None:
+        x = x.astype(compute_dtype)
+        h = h.astype(compute_dtype)
+        w = weights.w.astype(compute_dtype)
+    else:
+        w = weights.w
+    gates = jnp.concatenate([x, h], axis=-1) @ w
+    gates = gates.astype(jnp.float32) + weights.b.astype(jnp.float32)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c.astype(jnp.float32) + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    if compute_dtype is not None:
+        h_new = h_new.astype(compute_dtype)
+    return h_new, c_new

@@ -4,12 +4,20 @@ initializes, so distributed/mesh tests run without TPU hardware (SURVEY.md §4
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Hard-override: the session sitecustomize registers the axon TPU backend and
+# calls jax.config.update("jax_platforms", "axon,cpu"), which wins over the
+# env var — so update the config again after importing jax.  Unit tests must
+# run on the virtual multi-device CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
