@@ -1,0 +1,22 @@
+"""Data layer: vocabulary, dataset backends, fixed-shape batch iterator.
+
+Rebuilds the reference's ``dataloader.py`` capabilities (SURVEY.md §2 "Data
+loading": N feature h5 files + label h5 + cocofmt GT JSONs; batches videos,
+samples ``seq_per_img`` captions each, builds padded id matrices + masks)
+as a TPU-first pipeline: every batch has identical shapes (no recompiles),
+host batch assembly overlaps device compute via a prefetch thread, and the
+iterator can shard videos across hosts for multi-process training.
+"""
+
+from cst_captioning_tpu.data.vocab import Vocabulary, decode_sequence  # noqa: F401
+from cst_captioning_tpu.data.datasets import (  # noqa: F401
+    CaptionDataset,
+    InMemoryDataset,
+    H5Dataset,
+    make_synthetic_dataset,
+)
+from cst_captioning_tpu.data.loader import (  # noqa: F401
+    Batch,
+    BatchIterator,
+    prefetch_to_device,
+)

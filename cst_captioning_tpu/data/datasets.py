@@ -1,0 +1,250 @@
+"""Dataset backends: in-memory, HDF5, and a learnable synthetic corpus.
+
+Reference equivalent: ``dataloader.py`` (SURVEY.md §2) — opens one feature
+h5 per modality (resnet / c3d / mfcc), a label h5 (encoded caption matrix +
+per-video start/end index), and cocofmt GT JSONs.  Here a dataset object is
+one split; the vocabulary is shared across splits.
+
+The synthetic backend generates a corpus with real signal (caption tokens
+are a deterministic function of the video's latent topic, features are the
+topic embedding plus noise) so integration tests can overfit it — SURVEY.md
+§4 "tiny synthetic dataset → overfit ... to near-zero XE loss".
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from cst_captioning_tpu.data.vocab import Vocabulary
+
+
+class CaptionDataset:
+    """Interface: one split of a captioning dataset."""
+
+    vocab: Vocabulary
+    feature_dims: Dict[str, int]
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def video_id(self, idx: int) -> str:
+        raise NotImplementedError
+
+    def features(self, idx: int) -> Dict[str, np.ndarray]:
+        """modality -> (num_frames, dim) float32 (variable frame count)."""
+        raise NotImplementedError
+
+    def captions(self, idx: int) -> np.ndarray:
+        """(num_captions, T+2) int32 encoded [BOS..EOS PAD...] rows."""
+        raise NotImplementedError
+
+    def caption_weights(self, idx: int) -> np.ndarray:
+        """(num_captions,) float32 consensus weights (ones when absent)."""
+        return np.ones((self.captions(idx).shape[0],), np.float32)
+
+    def category(self, idx: int) -> int:
+        return 0
+
+    def references(self, idx: int) -> List[str]:
+        """Raw reference strings (for eval ground truth / CST rewards)."""
+        raise NotImplementedError
+
+
+class InMemoryDataset(CaptionDataset):
+    def __init__(
+        self,
+        vocab: Vocabulary,
+        video_ids: Sequence[str],
+        features: Dict[str, List[np.ndarray]],
+        captions: List[np.ndarray],
+        references: List[List[str]],
+        weights: Optional[List[np.ndarray]] = None,
+        categories: Optional[Sequence[int]] = None,
+    ):
+        self.vocab = vocab
+        self._ids = list(video_ids)
+        self._feats = features
+        self._caps = captions
+        self._refs = references
+        self._weights = weights
+        self._cats = list(categories) if categories is not None else None
+        self.feature_dims = {
+            m: int(arrs[0].shape[-1]) for m, arrs in features.items()
+        }
+        n = len(self._ids)
+        for m, arrs in features.items():
+            assert len(arrs) == n, f"modality {m}: {len(arrs)} != {n} videos"
+        assert len(captions) == n and len(references) == n
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def video_id(self, idx: int) -> str:
+        return self._ids[idx]
+
+    def features(self, idx: int) -> Dict[str, np.ndarray]:
+        return {m: arrs[idx] for m, arrs in self._feats.items()}
+
+    def captions(self, idx: int) -> np.ndarray:
+        return self._caps[idx]
+
+    def caption_weights(self, idx: int) -> np.ndarray:
+        if self._weights is None:
+            return super().caption_weights(idx)
+        return self._weights[idx]
+
+    def category(self, idx: int) -> int:
+        return self._cats[idx] if self._cats is not None else 0
+
+    def references(self, idx: int) -> List[str]:
+        return self._refs[idx]
+
+
+class H5Dataset(CaptionDataset):
+    """HDF5-backed split, mirroring the reference's on-disk layout
+    (SURVEY.md §2 "Data loading"): one feature file per modality plus a
+    label file.
+
+    Schema (written by ``tools/prepare_data.py``):
+      feature file ``<modality>.h5``: one dataset per video id, (F, D).
+      label file: ``captions`` (total, T+2) int32; ``cap_start``/``cap_end``
+      (V,) int64 index ranges per video; ``weights`` (total,) float32;
+      ``category`` (V,) int32; ``video_ids`` (V,) utf-8 strings; plus a
+      ``refs`` group: per-video raw reference strings for eval/rewards.
+    """
+
+    def __init__(self, label_file: str, feature_files: Dict[str, str],
+                 vocab: Vocabulary):
+        import h5py  # deferred: h5 path only
+
+        self.vocab = vocab
+        self._h5 = {m: h5py.File(p, "r") for m, p in feature_files.items()}
+        self._lab = h5py.File(label_file, "r")
+        self._ids = [
+            v.decode() if isinstance(v, bytes) else str(v)
+            for v in self._lab["video_ids"][()]
+        ]
+        self._start = self._lab["cap_start"][()]
+        self._end = self._lab["cap_end"][()]
+        self.feature_dims = {
+            m: int(f[self._ids[0]].shape[-1]) for m, f in self._h5.items()
+        }
+
+    def __len__(self) -> int:
+        return len(self._ids)
+
+    def video_id(self, idx: int) -> str:
+        return self._ids[idx]
+
+    def features(self, idx: int) -> Dict[str, np.ndarray]:
+        vid = self._ids[idx]
+        return {m: f[vid][()].astype(np.float32) for m, f in self._h5.items()}
+
+    def captions(self, idx: int) -> np.ndarray:
+        return self._lab["captions"][self._start[idx] : self._end[idx]].astype(
+            np.int32
+        )
+
+    def caption_weights(self, idx: int) -> np.ndarray:
+        if "weights" not in self._lab:
+            return super().caption_weights(idx)
+        return self._lab["weights"][self._start[idx] : self._end[idx]].astype(
+            np.float32
+        )
+
+    def category(self, idx: int) -> int:
+        if "category" not in self._lab:
+            return 0
+        return int(self._lab["category"][idx])
+
+    def references(self, idx: int) -> List[str]:
+        refs = self._lab["refs"][self.video_id(idx)][()]
+        return [r.decode() if isinstance(r, bytes) else str(r) for r in refs]
+
+    def close(self) -> None:
+        for f in self._h5.values():
+            f.close()
+        self._lab.close()
+
+
+# --------------------------------------------------------------- synthetic
+
+_SYNTH_NOUNS = [
+    "cat", "dog", "man", "woman", "car", "ball", "bird", "horse", "child",
+    "robot", "chef", "dancer", "player", "singer", "train",
+]
+_SYNTH_VERBS = [
+    "runs", "jumps", "sings", "drives", "cooks", "plays", "walks", "flies",
+    "dances", "sleeps",
+]
+_SYNTH_ADVS = ["quickly", "slowly", "happily", "loudly", "quietly", "gracefully"]
+
+
+def make_synthetic_dataset(
+    num_videos: int = 50,
+    refs_per_video: int = 3,
+    feature_dims: Optional[Dict[str, int]] = None,
+    max_frames: int = 6,
+    max_words: int = 10,
+    noise: float = 0.1,
+    num_categories: int = 0,
+    seed: int = 0,
+) -> Tuple[InMemoryDataset, Vocabulary]:
+    """Learnable toy corpus.  Video ``i`` has a topic (noun, verb); its
+    features are a fixed random embedding of the topic plus per-frame noise;
+    its references are "<noun> <verb> [<adverb>]" with the adverb varying
+    across references (so consensus scoring has real variance)."""
+    feature_dims = feature_dims or {"resnet": 64}
+    rng = np.random.RandomState(seed)
+    topics = [
+        (rng.randint(len(_SYNTH_NOUNS)), rng.randint(len(_SYNTH_VERBS)))
+        for _ in range(num_videos)
+    ]
+    all_tokens: List[List[str]] = []
+    per_video_refs: List[List[str]] = []
+    for n_i, v_i in topics:
+        refs = []
+        for r in range(refs_per_video):
+            words = [_SYNTH_NOUNS[n_i], _SYNTH_VERBS[v_i]]
+            if r > 0:
+                words.append(_SYNTH_ADVS[(n_i + v_i + r) % len(_SYNTH_ADVS)])
+            refs.append(" ".join(words))
+            all_tokens.append(words)
+        per_video_refs.append(refs)
+    vocab = Vocabulary.build(all_tokens, min_freq=1)
+
+    topic_embed = {
+        m: rng.randn(len(_SYNTH_NOUNS) * len(_SYNTH_VERBS), d).astype(np.float32)
+        for m, d in feature_dims.items()
+    }
+    feats: Dict[str, List[np.ndarray]] = {m: [] for m in feature_dims}
+    caps: List[np.ndarray] = []
+    for n_i, v_i in topics:
+        t = n_i * len(_SYNTH_VERBS) + v_i
+        nf = rng.randint(max_frames // 2 + 1, max_frames + 1)
+        for m in feature_dims:
+            base = topic_embed[m][t]
+            frames = base[None, :] + noise * rng.randn(nf, base.shape[0]).astype(
+                np.float32
+            )
+            feats[m].append(frames.astype(np.float32))
+    for refs in per_video_refs:
+        caps.append(
+            np.stack([vocab.encode(r.split(), max_words) for r in refs])
+        )
+    cats = (
+        [rng.randint(num_categories) for _ in range(num_videos)]
+        if num_categories
+        else None
+    )
+    ds = InMemoryDataset(
+        vocab=vocab,
+        video_ids=[f"video{i}" for i in range(num_videos)],
+        features=feats,
+        captions=caps,
+        references=per_video_refs,
+        categories=cats,
+    )
+    return ds, vocab

@@ -1,0 +1,216 @@
+"""Fixed-shape batch iterator with device prefetch.
+
+Reference equivalent: ``dataloader.py``'s ``get_batch(split)`` (SURVEY.md
+§2/§3.1) — batches videos, samples ``seq_per_img`` captions each, builds the
+padded id matrix + mask.  TPU-first differences:
+
+* Every batch has *identical* shapes (batch padded by wrapping around the
+  video list on the final partial batch when ``drop_last=False``) so the
+  jitted train step never recompiles.
+* Frames are uniformly subsampled / zero-padded to ``max_frames`` with a
+  validity mask — the reference's variable-length h5 reads become static
+  (B, F, D) tensors.
+* ``shard_id / num_shards`` slice the video list per host process for
+  multi-host data parallelism (each host feeds its own chips).
+* ``prefetch_to_device`` overlaps host batch assembly + H2D transfer with
+  device compute via a daemon thread (the reference blocks on h5 reads and
+  ``.cuda()`` per step).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, List, NamedTuple, Optional
+
+import numpy as np
+
+from cst_captioning_tpu.data.datasets import CaptionDataset
+
+
+class Batch(NamedTuple):
+    """One fixed-shape training batch (all numpy, host-side).
+
+    B = videos per batch, S = seq_per_img, F = max_frames, L = caption slots
+    (max_words + 2 for BOS/EOS).
+    """
+
+    feats: Dict[str, np.ndarray]        # m -> (B, F, D_m) float32
+    feat_masks: Dict[str, np.ndarray]   # m -> (B, F) float32
+    captions: np.ndarray                # (B, S, L) int32
+    weights: np.ndarray                 # (B, S) float32 consensus weights
+    category: np.ndarray                # (B,) int32
+    video_idx: np.ndarray               # (B,) int32 dataset indices
+    video_ids: List[str]                # host-side ids (not shipped to device)
+
+
+def subsample_frames(frames: np.ndarray, max_frames: int) -> np.ndarray:
+    """Uniform temporal subsample to at most ``max_frames`` rows."""
+    if frames.shape[0] <= max_frames:
+        return frames
+    idx = np.linspace(0, frames.shape[0] - 1, max_frames).round().astype(int)
+    return frames[idx]
+
+
+class BatchIterator:
+    """Epoch-based iterator over a :class:`CaptionDataset`."""
+
+    def __init__(
+        self,
+        dataset: CaptionDataset,
+        batch_size: int,
+        seq_per_img: int,
+        max_frames: int,
+        *,
+        shuffle: bool = True,
+        drop_last: bool = False,
+        seed: int = 0,
+        shard_id: int = 0,
+        num_shards: int = 1,
+    ):
+        if not (0 <= shard_id < num_shards):
+            raise ValueError(f"bad shard {shard_id}/{num_shards}")
+        self.ds = dataset
+        self.batch_size = batch_size
+        self.seq_per_img = seq_per_img
+        self.max_frames = max_frames
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        # Host sharding: contiguous-stride split of the video index space.
+        self._indices = np.arange(shard_id, len(dataset), num_shards)
+        self.caption_len = int(dataset.captions(0).shape[1])
+
+    def num_batches(self) -> int:
+        n = len(self._indices)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    def epoch(self, epoch: int) -> Iterator[Batch]:
+        """Deterministic per-epoch stream (seed + epoch -> permutation)."""
+        order = self._indices.copy()
+        rng = np.random.RandomState(self.seed + 1000003 * epoch)
+        if self.shuffle:
+            rng.shuffle(order)
+        n = len(order)
+        limit = (n // self.batch_size) * self.batch_size if self.drop_last else n
+        for start in range(0, limit, self.batch_size):
+            chunk = order[start : start + self.batch_size]
+            if len(chunk) < self.batch_size:
+                # Wrap-around pad (tiling as needed when the shard is
+                # smaller than a batch): keeps shapes static; duplicated
+                # videos contribute slightly more gradient once per epoch.
+                pad = np.resize(order, self.batch_size - len(chunk))
+                chunk = np.concatenate([chunk, pad])
+            yield self._assemble(chunk, rng)
+
+    # ------------------------------------------------------------ assembly
+    def _assemble(self, idxs: np.ndarray, rng: np.random.RandomState) -> Batch:
+        B, S, F, L = (
+            len(idxs),
+            self.seq_per_img,
+            self.max_frames,
+            self.caption_len,
+        )
+        feats = {
+            m: np.zeros((B, F, d), np.float32)
+            for m, d in self.ds.feature_dims.items()
+        }
+        fmasks = {m: np.zeros((B, F), np.float32) for m in self.ds.feature_dims}
+        captions = np.zeros((B, S, L), np.int32)
+        weights = np.ones((B, S), np.float32)
+        category = np.zeros((B,), np.int32)
+        for b, i in enumerate(idxs):
+            i = int(i)
+            for m, fr in self.ds.features(i).items():
+                fr = subsample_frames(fr, F)
+                feats[m][b, : fr.shape[0]] = fr
+                fmasks[m][b, : fr.shape[0]] = 1.0
+            caps = self.ds.captions(i)
+            w = self.ds.caption_weights(i)
+            n = caps.shape[0]
+            # Sample seq_per_img captions per video: without replacement
+            # when possible, with replacement otherwise (reference
+            # dataloader.py behavior for videos with few captions).
+            pick = (
+                rng.choice(n, S, replace=False)
+                if n >= S
+                else rng.choice(n, S, replace=True)
+            )
+            captions[b] = caps[pick]
+            weights[b] = w[pick]
+            category[b] = self.ds.category(i)
+        return Batch(
+            feats=feats,
+            feat_masks=fmasks,
+            captions=captions,
+            weights=weights,
+            category=category,
+            video_idx=idxs.astype(np.int32),
+            video_ids=[self.ds.video_id(int(i)) for i in idxs],
+        )
+
+
+def prefetch_to_device(
+    batches: Iterator[Batch],
+    size: int = 2,
+    sharding=None,
+) -> Iterator[Batch]:
+    """Stage batches onto the device(s) ahead of consumption.
+
+    A daemon thread assembles host batches and ``jax.device_put``s the array
+    fields (with ``sharding`` when given — the data-parallel batch sharding
+    in the mesh path), so H2D transfer overlaps the previous step's compute.
+    ``video_ids`` stays on host.
+    """
+    import jax
+
+    q: "queue.Queue" = queue.Queue(maxsize=size)
+    END = object()
+    stop = threading.Event()
+
+    def _put(item) -> bool:
+        """Bounded put that gives up once the consumer is gone."""
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def worker():
+        try:
+            for b in batches:
+                arrays = b._asdict()
+                put = {
+                    k: jax.device_put(v, sharding)
+                    if isinstance(v, (np.ndarray,))
+                    else (
+                        {m: jax.device_put(a, sharding) for m, a in v.items()}
+                        if isinstance(v, dict)
+                        else v
+                    )
+                    for k, v in arrays.items()
+                }
+                if not _put(Batch(**put)):
+                    return
+            _put(END)
+        except BaseException as e:  # surface worker errors to the consumer
+            _put(e)
+
+    threading.Thread(target=worker, daemon=True).start()
+    try:
+        while True:
+            item = q.get()
+            if item is END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        # Abandoned mid-epoch (exception/GeneratorExit in the consumer):
+        # release the worker so it exits instead of blocking on a full
+        # queue holding device-resident batches.
+        stop.set()

@@ -35,18 +35,19 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from cst_captioning_tpu.constants import (  # noqa: F401  (re-exported)
+    BOS_ID,
+    EOS_ID,
+    NUM_SPECIAL_TOKENS,
+    PAD_ID,
+    UNK_ID,
+)
 from cst_captioning_tpu.ops.rnn import (
     LSTMWeights,
     lstm_bias_init,
     lstm_kernel_init,
     lstm_step,
 )
-
-PAD_ID = 0
-BOS_ID = 1
-EOS_ID = 2
-UNK_ID = 3
-NUM_SPECIAL_TOKENS = 4
 
 
 class SampleOutput(NamedTuple):

@@ -1,0 +1,1 @@
+"""Offline data-prep CLIs (SURVEY.md §3.4 / L- layer)."""

@@ -1,0 +1,16 @@
+"""Training layer: jitted steps, optimizer, trainer loop, checkpointing.
+
+Rebuilds the reference's ``train.py`` (SURVEY.md §2 "Training driver",
+§3.1-3.2): epoch loop with XE / WXE / CST mode switch, Adam + stepwise LR
+decay + grad clipping, per-epoch validation language eval, keep-best on val
+CIDEr, early stopping, history json, checkpoint/warm-start staging
+(XE -> WXE -> CST).
+"""
+
+from cst_captioning_tpu.training.steps import (  # noqa: F401
+    TrainState,
+    create_train_state,
+    make_xe_train_step,
+    make_greedy_sample_fn,
+)
+from cst_captioning_tpu.training.trainer import Trainer  # noqa: F401

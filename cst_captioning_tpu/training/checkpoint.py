@@ -1,0 +1,84 @@
+"""Orbax checkpointing with keep-best + warm-start semantics.
+
+Reference equivalent (SURVEY.md §5 "Checkpoint / resume"): ``torch.save``
+of model+optimizer+infos each epoch, a "best on val CIDEr" copy, and CST
+stages warm-starting from the WXE/XE checkpoint (``--start_from``).
+
+Layout: ``<path>/params`` and ``<path>/opt`` are separate orbax items so a
+warm start (params only — each stage restarts its optimizer/LR schedule)
+never needs to know the previous stage's optimizer structure.
+``<path>/infos.json`` is a human-readable sidecar (epoch, val metrics).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import orbax.checkpoint as ocp
+
+
+def _abs(path: str) -> str:
+    return os.path.abspath(path)
+
+
+def save_checkpoint(path: str, state, extra: Optional[Dict[str, Any]] = None
+                    ) -> None:
+    """Save a TrainState: params + (opt_state, step) + json sidecar."""
+    path = _abs(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(os.path.join(path, "params"), state.params, force=True)
+    ckptr.save(
+        os.path.join(path, "opt"),
+        {"opt_state": state.opt_state, "step": jnp.asarray(state.step)},
+        force=True,
+    )
+    ckptr.wait_until_finished()
+    if extra is not None:
+        with open(os.path.join(path, "infos.json"), "w") as f:
+            json.dump(extra, f, indent=2, default=str)
+
+
+def load_infos(path: str) -> Dict[str, Any]:
+    p = os.path.join(_abs(path), "infos.json")
+    if not os.path.exists(p):
+        return {}
+    with open(p) as f:
+        return json.load(f)
+
+
+def restore_checkpoint(path: str, state):
+    """Full resume: params + optimizer + step into ``state``'s structure."""
+    path = _abs(path)
+    ckptr = ocp.StandardCheckpointer()
+    params = ckptr.restore(
+        os.path.join(path, "params"),
+        jax.tree.map(ocp.utils.to_shape_dtype_struct, state.params),
+    )
+    opt = ckptr.restore(
+        os.path.join(path, "opt"),
+        {
+            "opt_state": jax.tree.map(
+                ocp.utils.to_shape_dtype_struct, state.opt_state
+            ),
+            "step": ocp.utils.to_shape_dtype_struct(jnp.asarray(state.step)),
+        },
+    )
+    return state.replace(
+        params=params,
+        opt_state=opt["opt_state"],
+        step=int(opt["step"]),
+    )
+
+
+def restore_params(path: str, params_template):
+    """Warm start (reference ``--start_from``): parameters only."""
+    path = _abs(path)
+    ckptr = ocp.StandardCheckpointer()
+    return ckptr.restore(
+        os.path.join(path, "params"),
+        jax.tree.map(ocp.utils.to_shape_dtype_struct, params_template),
+    )

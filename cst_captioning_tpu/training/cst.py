@@ -1,0 +1,154 @@
+"""CST / SCST training step: consensus-based self-critical REINFORCE.
+
+Reference equivalent (SURVEY.md §3.2, the paper's core loop in
+``train.py``): per step — greedy decode for the baseline, multinomial
+rollout(s), in-loop CIDEr-D scoring of both against the video's references,
+advantage = reward - baseline, policy-gradient loss on the rollout
+log-probs.  Variants (reference Makefile targets):
+
+* ``cst_baseline="greedy"``  — CST_MS_Greedy / classic SCST (greedy-decode
+  reward as baseline, arXiv:1612.00563).
+* ``cst_baseline="scb"``     — CST_MS_SCB: the paper's self-consensus
+  baseline; with S rollouts per video the baseline for rollout j is the
+  leave-one-out mean reward of the video's other rollouts.
+* ``cst_baseline="none"``    — raw REINFORCE (no baseline).
+* ``CST_GT_None`` (GT captions as "samples" weighted by consensus) is the
+  WXE path in ``training/steps.py`` — no sampling involved.
+
+TPU-first design: the ENTIRE step — S multinomial rollouts, greedy
+baseline decode, reward lookup, PG loss, backward, Adam update — is one
+jitted graph.  The only host work is the CIDEr-D scorer, reached through
+``jax.experimental.io_callback`` (SURVEY.md §3.2: the reference crosses
+device<->host twice per step; here XLA overlaps the callback with device
+compute, and references are pre-cooked at startup).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.experimental import io_callback
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.models.captioner import CaptionModel
+from cst_captioning_tpu.ops.losses import reward_criterion
+from cst_captioning_tpu.training.rewards import CiderDRewarder
+
+
+def make_cst_train_step(
+    model: CaptionModel, cfg, train_ds
+) -> Callable:
+    """Build the jitted CST step.  Same signature as the XE step
+    (``trainer.py`` dispatch): ``(state, feats, feat_masks, captions,
+    weights, category, video_idx, rng, ss_prob) -> (state, metrics)``;
+    ``captions``/``weights``/``ss_prob`` are unused (sampling-based regime).
+    """
+    rewarder = CiderDRewarder(
+        train_ds,
+        df_mode=cfg.data.idf_file or "corpus",
+    )
+    S = max(1, cfg.train.cst_num_samples)
+    baseline_kind = cfg.train.cst_baseline
+    if baseline_kind not in ("greedy", "scb", "none"):
+        raise ValueError(f"unknown cst_baseline {baseline_kind!r}")
+    if baseline_kind == "scb" and S < 2:
+        raise ValueError(
+            "cst_baseline='scb' needs cst_num_samples >= 2 (the leave-one-"
+            "out consensus baseline is undefined for a single rollout)"
+        )
+    temperature = cfg.train.sample_temperature
+    max_len = cfg.data.max_seq_len
+
+    def host_score(video_idx, tokens):
+        return rewarder.score_ids(video_idx, tokens).astype(np.float32)
+
+    def score(video_idx, tokens):
+        return io_callback(
+            host_score,
+            jax.ShapeDtypeStruct((tokens.shape[0],), jnp.float32),
+            video_idx,
+            tokens,
+        )
+
+    def train_step(state, feats, feat_masks, captions, weights, category,
+                   video_idx, rng, ss_prob):
+        B = video_idx.shape[0]
+        feats_r = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
+        masks_r = {m: jnp.repeat(v, S, axis=0) for m, v in feat_masks.items()}
+        cat_r = jnp.repeat(category, S, axis=0) if category is not None else None
+        vid_r = jnp.repeat(video_idx, S, axis=0)
+
+        # --- rollouts + rewards (no gradient; recomputed under grad below)
+        rollout = state.apply_fn(
+            state.params,
+            feats_r,
+            masks_r,
+            rng=rng,
+            category=cat_r,
+            max_len=max_len,
+            greedy=False,
+            temperature=temperature,
+            method="sample",
+        )
+        rewards = score(vid_r, rollout.tokens)  # (B*S,)
+
+        if baseline_kind == "greedy":
+            greedy = state.apply_fn(
+                state.params,
+                feats,
+                feat_masks,
+                category=category,
+                max_len=max_len,
+                greedy=True,
+                method="sample",
+            )
+            baseline = jnp.repeat(score(video_idx, greedy.tokens), S, axis=0)
+        elif baseline_kind == "scb":
+            # Leave-one-out mean over the video's other rollouts.
+            r = rewards.reshape(B, S)
+            if S > 1:
+                loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
+            else:
+                loo = jnp.zeros_like(r)
+            baseline = loo.reshape(B * S)
+        else:
+            baseline = jnp.zeros_like(rewards)
+        advantage = rewards - baseline
+
+        # --- PG loss: re-run teacher forcing over the SAMPLED tokens so the
+        # graph from logits to params is differentiable (the rollout above
+        # is decode-only).  Input = [BOS, tok_0..tok_{L-2}], target = tokens.
+        bos = jnp.full((B * S, 1), BOS_ID, jnp.int32)
+        inputs = jnp.concatenate([bos, rollout.tokens[:, :-1]], axis=1)
+        # Finished rows feed EOS, not PAD, to keep embeddings defined.
+        inputs = jnp.where(inputs == PAD_ID, EOS_ID, inputs)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                params, feats_r, masks_r, inputs, category=cat_r
+            )
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            tok_lp = jnp.take_along_axis(
+                logp, rollout.tokens[..., None], axis=-1
+            )[..., 0]
+            return reward_criterion(tok_lp, rollout.mask, advantage)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads=grads)
+        return state, {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "reward": rewards.mean(),
+            "baseline": baseline.mean(),
+            "advantage": advantage.mean(),
+        }
+
+    # ss_prob stays a traced (unused) arg — marking it static would recompile
+    # the whole rollout+backward graph whenever a scheduled-sampling config
+    # ticks its probability.
+    return jax.jit(train_step, donate_argnums=(0,))

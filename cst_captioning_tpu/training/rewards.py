@@ -1,0 +1,123 @@
+"""In-loop CIDEr-D reward scoring for CST/SCST — the host side.
+
+Reference equivalent (SURVEY.md §3.2): the reference decodes sampled id
+sequences to strings and calls ``CiderD.compute_score`` against each
+video's references every training step.  SURVEY.md ranks this host scorer
+as hot loop #2: it must stay far cheaper than the device step.
+
+TPU-first design:
+* Scoring happens directly on **token ids** — references are vocab-encoded
+  once at startup, so n-grams are tuples of ints and the per-step
+  ids->string->re-tokenize round trip is gone.  (Id n-grams and word
+  n-grams are in bijection under a fixed vocab, so scores are identical to
+  string scoring; the reference's own reward path scores vocab-decoded
+  strings, carrying exactly the same information.)
+* Reference n-gram vectors are **pre-cooked per video** at startup
+  (``cook_refs_vec``) — per step only the candidates are cooked.
+* The scorer is called from inside the jitted CST step through
+  ``jax.experimental.io_callback`` (see ``training/cst.py``).
+* A drop-in C++ scorer (``native/``) replaces the Python inner loop when
+  built — same cooked-ref layout, same results.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID, UNK_ID
+from cst_captioning_tpu.data.datasets import CaptionDataset
+from cst_captioning_tpu.metrics.cider import (
+    NGRAMS,
+    _CiderBase,
+    ciderd_score_vec,
+    compute_doc_freq,
+    cook_refs_vec,
+    precook,
+)
+from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize
+
+
+def ids_until_end(row: Sequence[int]) -> List[int]:
+    """Candidate tokens: everything before the first PAD/EOS, skipping BOS
+    (sampled sequences never contain BOS, but encoded refs do)."""
+    out = []
+    for t in row:
+        t = int(t)
+        if t in (PAD_ID, EOS_ID):
+            break
+        if t == BOS_ID:
+            continue
+        out.append(t)
+    return out
+
+
+class CiderDRewarder:
+    """CIDEr-D over token-id sequences with startup-cooked references."""
+
+    def __init__(
+        self,
+        dataset: CaptionDataset,
+        df_mode: str = "corpus",
+        use_d: bool = True,
+    ):
+        """``df_mode="corpus"``: document frequencies over this dataset's
+        reference sets (the reference's train-corpus idf option);
+        otherwise a path to a saved idf table (reference pickle parity) —
+        in that case the table's *string* n-grams are re-encoded through
+        the vocab so they match id n-grams.
+        """
+        self.vocab = dataset.vocab
+        self.use_d = use_d
+        w2i = self.vocab.word_to_idx
+
+        def encode_tokens(tokens: List[str]) -> List[int]:
+            return [w2i.get(t, UNK_ID) for t in tokens]
+
+        # Vocab-encode every video's references (tokenize like the metric
+        # pipeline so idf tables and eval tokenization agree).
+        self._cooked_refs = []
+        for i in range(len(dataset)):
+            refs = dataset.references(i)
+            self._cooked_refs.append(
+                [precook(encode_tokens(ptb_tokenize(r))) for r in refs]
+            )
+
+        if df_mode == "corpus":
+            self.doc_freq = compute_doc_freq(self._cooked_refs)
+            self.log_ref_len = math.log(float(max(len(dataset), 2)))
+        else:
+            base = _CiderBase(df_mode=df_mode)
+            # Re-key string n-grams to id n-grams.
+            self.doc_freq = {}
+            for ngram, df in base._df.items():
+                key = tuple(w2i.get(w, UNK_ID) for w in ngram)
+                # Collisions (via UNK) keep the max df — conservative idf.
+                self.doc_freq[key] = max(df, self.doc_freq.get(key, 0.0))
+            self.log_ref_len = base._log_ref_len
+
+        self._ref_vecs = [
+            cook_refs_vec(refs, self.doc_freq, self.log_ref_len)
+            for refs in self._cooked_refs
+        ]
+
+    def score_ids(
+        self, video_idx: np.ndarray, token_ids: np.ndarray
+    ) -> np.ndarray:
+        """(B,) video dataset indices + (B, L) sampled ids -> (B,) float32
+        CIDEr-D scores (x10 scale, like the reference scorer)."""
+        video_idx = np.asarray(video_idx)
+        token_ids = np.asarray(token_ids)
+        out = np.zeros((token_ids.shape[0],), np.float32)
+        for b in range(token_ids.shape[0]):
+            cand = precook(ids_until_end(token_ids[b]))
+            out[b] = ciderd_score_vec(
+                cand,
+                self._ref_vecs[int(video_idx[b])],
+                self.doc_freq,
+                self.log_ref_len,
+                use_d=self.use_d,
+            )
+        return out

@@ -1,0 +1,175 @@
+"""Jitted train/eval step factories and optimizer construction.
+
+Reference equivalent: the inner block of ``train.py``'s epoch loop
+(SURVEY.md §3.1) — forward, masked-(W)XE loss, backward, Adam step, LR
+decay, grad clip.  Here the whole block is ONE jitted function with donated
+state; the LR decay is an optax schedule (factor ``lr_decay`` every
+``lr_decay_every`` epochs, reference ``opts.py`` flags) baked into the
+optimizer, so no Python-side LR mutation exists.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from flax.training import train_state
+
+from cst_captioning_tpu.models.captioner import CaptionModel, PAD_ID
+from cst_captioning_tpu.ops.losses import weighted_cross_entropy
+
+
+class TrainState(train_state.TrainState):
+    """flax TrainState (params, tx, opt_state, step) — no extra fields."""
+
+
+def make_lr_schedule(cfg_train, steps_per_epoch: int) -> optax.Schedule:
+    """lr * decay^(epoch // decay_every), epoch = step // steps_per_epoch."""
+    base, decay, every = (
+        cfg_train.learning_rate,
+        cfg_train.lr_decay,
+        cfg_train.lr_decay_every,
+    )
+    if every <= 0 or decay >= 1.0 - 1e-9:
+        return optax.constant_schedule(base)
+    decay_steps = max(1, every * steps_per_epoch)
+
+    def schedule(step):
+        return base * jnp.power(decay, step // decay_steps)
+
+    return schedule
+
+
+def make_optimizer(cfg_train, steps_per_epoch: int) -> optax.GradientTransformation:
+    sched = make_lr_schedule(cfg_train, steps_per_epoch)
+    parts = []
+    if cfg_train.grad_clip > 0:
+        parts.append(optax.clip_by_global_norm(cfg_train.grad_clip))
+    if cfg_train.optimizer == "adam":
+        if cfg_train.weight_decay > 0:
+            parts.append(
+                optax.adamw(
+                    sched,
+                    b1=cfg_train.beta1,
+                    b2=cfg_train.beta2,
+                    eps=cfg_train.epsilon,
+                    weight_decay=cfg_train.weight_decay,
+                )
+            )
+        else:
+            parts.append(
+                optax.adam(
+                    sched,
+                    b1=cfg_train.beta1,
+                    b2=cfg_train.beta2,
+                    eps=cfg_train.epsilon,
+                )
+            )
+    elif cfg_train.optimizer == "sgd":
+        parts.append(optax.sgd(sched))
+    elif cfg_train.optimizer == "rmsprop":
+        parts.append(optax.rmsprop(sched))
+    else:
+        raise ValueError(f"unknown optimizer {cfg_train.optimizer!r}")
+    return optax.chain(*parts)
+
+
+def create_train_state(
+    rng: jax.Array,
+    model: CaptionModel,
+    tx: optax.GradientTransformation,
+    sample_batch: Dict[str, Any],
+) -> TrainState:
+    """Initialize params from one (host) batch's shapes."""
+    feats = {m: jnp.asarray(v[:1]) for m, v in sample_batch["feats"].items()}
+    masks = {
+        m: jnp.asarray(v[:1]) for m, v in sample_batch["feat_masks"].items()
+    }
+    ids = jnp.asarray(sample_batch["captions"][:1, 0, :-1])
+    cat = (
+        jnp.asarray(sample_batch["category"][:1])
+        if model.use_category
+        else None
+    )
+    params = model.init(rng, feats, masks, ids, category=cat)
+    return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+
+
+def _flatten_batch(model: CaptionModel, feats, feat_masks, captions, weights,
+                   category):
+    """(B, S, L) captions + (B, ...) video tensors -> caption-major arrays:
+    every caption row gets its video's features (jnp.repeat on device —
+    the reference tiles on host in ``dataloader.py``)."""
+    B, S, L = captions.shape
+    feats_r = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
+    masks_r = {m: jnp.repeat(v, S, axis=0) for m, v in feat_masks.items()}
+    caps = captions.reshape(B * S, L)
+    w = weights.reshape(B * S)
+    cat = jnp.repeat(category, S, axis=0) if category is not None else None
+    return feats_r, masks_r, caps, w, cat
+
+
+def make_xe_train_step(
+    model: CaptionModel,
+) -> Callable:
+    """XE/WXE train step. WXE == XE with non-uniform ``weights`` (the loader
+    supplies consensus weights; ones for plain XE), reference train_mode
+    switch in ``train.py``.
+
+    Signature (shared with the CST step so the trainer dispatches
+    uniformly): ``(state, feats, feat_masks, captions(B,S,L), weights(B,S),
+    category(B,)|None, video_idx(B,), rng, ss_prob) -> (state, metrics)``;
+    ``video_idx`` is unused here (the CST step needs it for reward refs).
+    """
+
+    def train_step(state, feats, feat_masks, captions, weights, category,
+                   video_idx, rng, ss_prob):
+        feats_r, masks_r, caps, w, cat = _flatten_batch(
+            model, feats, feat_masks, captions, weights, category
+        )
+        inputs, targets = caps[:, :-1], caps[:, 1:]
+        tmask = (targets != PAD_ID).astype(jnp.float32)
+        rng_drop, rng_ss = jax.random.split(rng)
+
+        def loss_fn(params):
+            logits = state.apply_fn(
+                params,
+                feats_r,
+                masks_r,
+                inputs,
+                category=cat,
+                ss_prob=ss_prob,
+                deterministic=False,
+                rng=rng_ss,
+                rngs={"dropout": rng_drop},
+            )
+            return weighted_cross_entropy(logits, targets, tmask, w)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        gnorm = optax.global_norm(grads)
+        state = state.apply_gradients(grads=grads)
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    # ss_prob is static so the model's statically-zero scheduled-sampling
+    # guard applies (it changes a handful of times per run — one recompile
+    # per distinct value, reference schedule steps every 5 epochs).
+    return jax.jit(train_step, donate_argnums=(0,), static_argnums=(8,))
+
+
+def make_greedy_sample_fn(model: CaptionModel, max_len: int) -> Callable:
+    """Jitted greedy decode for validation (reference per-epoch val pass)."""
+
+    def sample(params, feats, feat_masks, category):
+        return model.apply(
+            params,
+            feats,
+            feat_masks,
+            category=category,
+            max_len=max_len,
+            greedy=True,
+            method="sample",
+        ).tokens
+
+    return jax.jit(sample)

@@ -1,0 +1,261 @@
+"""Trainer: the reference ``train.py`` driver, rebuilt around jitted steps.
+
+Epoch loop with scheduled-sampling schedule, per-epoch validation language
+eval (greedy decode -> metric suite), keep-best on val CIDEr, early
+stopping on patience, history json, per-epoch + best checkpoints, and
+warm-start staging (XE -> WXE -> CST via ``train.start_from``) — SURVEY.md
+§2 "Training driver" / §5.
+
+The CST (REINFORCE) step is provided by ``training/cst.py``; this class
+dispatches on ``cfg.train.train_mode``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+from cst_captioning_tpu.config import Config
+from cst_captioning_tpu.data.datasets import CaptionDataset
+from cst_captioning_tpu.data.loader import BatchIterator, prefetch_to_device
+from cst_captioning_tpu.data.vocab import Vocabulary, decode_sequence
+from cst_captioning_tpu.metrics.evaluator import language_eval
+from cst_captioning_tpu.models.captioner import model_from_config
+from cst_captioning_tpu.training import checkpoint as ckpt
+from cst_captioning_tpu.training.steps import (
+    create_train_state,
+    make_greedy_sample_fn,
+    make_optimizer,
+    make_xe_train_step,
+)
+
+log = logging.getLogger("cst_captioning_tpu.trainer")
+
+
+def scheduled_sampling_prob(cfg_model, epoch: int) -> float:
+    """Reference ``opts.py`` schedule: zero before ``start``, then
+    ``increase_prob`` more every ``increase_every`` epochs, capped."""
+    if cfg_model.scheduled_sampling_start < 0:
+        return 0.0
+    if epoch < cfg_model.scheduled_sampling_start:
+        return 0.0
+    frac = (
+        epoch - cfg_model.scheduled_sampling_start
+    ) // cfg_model.scheduled_sampling_increase_every + 1
+    return float(
+        min(
+            cfg_model.scheduled_sampling_increase_prob * frac,
+            cfg_model.scheduled_sampling_max_prob,
+        )
+    )
+
+
+class Trainer:
+    def __init__(
+        self,
+        cfg: Config,
+        train_ds: CaptionDataset,
+        val_ds: Optional[CaptionDataset] = None,
+        workdir: Optional[str] = None,
+        shard_id: int = 0,
+        num_shards: int = 1,
+    ):
+        self.cfg = cfg
+        self.train_ds = train_ds
+        self.val_ds = val_ds
+        self.vocab: Vocabulary = train_ds.vocab
+        if cfg.model.vocab_size == 0:
+            cfg.model.vocab_size = len(self.vocab)
+        self.workdir = workdir or os.path.join(
+            cfg.train.checkpoint_dir, cfg.name
+        )
+        os.makedirs(self.workdir, exist_ok=True)
+
+        self.model = model_from_config(cfg)
+        self.train_iter = BatchIterator(
+            train_ds,
+            batch_size=cfg.data.batch_size,
+            seq_per_img=cfg.data.seq_per_img,
+            max_frames=cfg.data.max_frames,
+            shuffle=cfg.data.shuffle,
+            drop_last=cfg.data.drop_last,
+            seed=cfg.train.seed,
+            shard_id=shard_id,
+            num_shards=num_shards,
+        )
+        steps_per_epoch = max(1, self.train_iter.num_batches())
+        self.tx = make_optimizer(cfg.train, steps_per_epoch)
+
+        rng = jax.random.PRNGKey(cfg.train.seed)
+        self.rng, init_rng = jax.random.split(rng)
+        first = next(iter(self.train_iter.epoch(0)))
+        self.state = create_train_state(
+            init_rng, self.model, self.tx, first._asdict()
+        )
+        if cfg.train.start_from:
+            log.info("warm start from %s", cfg.train.start_from)
+            self.state = self.state.replace(
+                params=ckpt.restore_params(
+                    cfg.train.start_from, self.state.params
+                )
+            )
+        self._build_steps()
+        self.history: Dict[str, dict] = {}
+        self.best_score = -np.inf
+        self.best_epoch = -1
+
+    # ------------------------------------------------------------- plumbing
+    def _build_steps(self) -> None:
+        mode = self.cfg.train.train_mode
+        if mode in ("xe", "wxe"):
+            self._train_step = make_xe_train_step(self.model)
+        elif mode == "cst":
+            from cst_captioning_tpu.training.cst import make_cst_train_step
+
+            self._train_step = make_cst_train_step(
+                self.model, self.cfg, self.train_ds
+            )
+        else:
+            raise ValueError(f"unknown train_mode {mode!r}")
+        self._sample_fn = make_greedy_sample_fn(
+            self.model, self.cfg.eval.max_decode_len
+        )
+
+    def _category(self, batch) -> Optional[jax.Array]:
+        return batch.category if self.model.use_category else None
+
+    # ------------------------------------------------------------ training
+    def train_epoch(self, epoch: int) -> Dict[str, float]:
+        cfg = self.cfg
+        ss_prob = scheduled_sampling_prob(cfg.model, epoch)
+        # Plain XE ignores consensus weights (reference train_mode switch).
+        use_weights = cfg.train.train_mode != "xe"
+        # Device scalars accumulated without forcing a host sync per step;
+        # converted once at epoch end.
+        acc: Dict[str, List[jax.Array]] = {}
+        t0 = time.time()
+        nsteps = 0
+        for batch in prefetch_to_device(self.train_iter.epoch(epoch)):
+            self.rng, step_rng = jax.random.split(self.rng)
+            weights = (
+                batch.weights
+                if use_weights
+                else jax.numpy.ones_like(batch.weights)
+            )
+            self.state, metrics = self._train_step(
+                self.state,
+                batch.feats,
+                batch.feat_masks,
+                batch.captions,
+                weights,
+                self._category(batch),
+                batch.video_idx,
+                step_rng,
+                ss_prob,
+            )
+            for k, v in metrics.items():
+                acc.setdefault(k, []).append(v)
+            nsteps += 1
+            if nsteps % cfg.train.log_every == 0:
+                log.info(
+                    "epoch %d step %d loss %.4f (%.2f steps/s)",
+                    epoch, nsteps, float(metrics["loss"]),
+                    nsteps / (time.time() - t0),
+                )
+        out = {
+            f"train_{k}" if k == "loss" else k: float(
+                np.mean([float(x) for x in v])
+            )
+            for k, v in acc.items()
+        }
+        out.setdefault("train_loss", float("nan"))
+        out["ss_prob"] = ss_prob
+        out["steps_per_sec"] = nsteps / max(time.time() - t0, 1e-9)
+        return out
+
+    # ---------------------------------------------------------- evaluation
+    def predict(self, ds: CaptionDataset) -> Dict[str, str]:
+        """Greedy-decode every video once -> {video_id: caption}."""
+        it = BatchIterator(
+            ds,
+            batch_size=self.cfg.data.batch_size,
+            seq_per_img=1,
+            max_frames=self.cfg.data.max_frames,
+            shuffle=False,
+            drop_last=False,
+        )
+        preds: Dict[str, str] = {}
+        for batch in it.epoch(0):
+            toks = self._sample_fn(
+                self.state.params,
+                {m: jax.numpy.asarray(v) for m, v in batch.feats.items()},
+                {m: jax.numpy.asarray(v) for m, v in batch.feat_masks.items()},
+                self._category(batch),
+            )
+            for vid, sent in zip(
+                batch.video_ids, decode_sequence(self.vocab, np.asarray(toks))
+            ):
+                preds[vid] = sent
+        return preds
+
+    def evaluate(self, ds: Optional[CaptionDataset] = None) -> Dict[str, float]:
+        ds = ds or self.val_ds
+        assert ds is not None, "no validation dataset"
+        preds = self.predict(ds)
+        gts = {
+            ds.video_id(i): ds.references(i) for i in range(len(ds))
+        }
+        res = {vid: [preds[vid]] for vid in gts}
+        return language_eval(gts, res, metrics=self.cfg.eval.metrics)
+
+    # ----------------------------------------------------------------- fit
+    def fit(self) -> Dict[str, dict]:
+        cfg = self.cfg
+        patience = 0
+        for epoch in range(cfg.train.max_epochs):
+            entry = self.train_epoch(epoch)
+            if self.val_ds is not None and (epoch + 1) % cfg.train.eval_every == 0:
+                val = self.evaluate()
+                entry["val"] = val
+                score = val.get("CIDEr", next(iter(val.values())))
+                if score > self.best_score:
+                    self.best_score = score
+                    self.best_epoch = epoch
+                    patience = 0
+                    ckpt.save_checkpoint(
+                        os.path.join(self.workdir, "best"),
+                        self.state,
+                        {"epoch": epoch, "val": val, "config": cfg.to_dict()},
+                    )
+                else:
+                    patience += 1
+                log.info(
+                    "epoch %d val %s (best CIDEr %.4f @ %d)",
+                    epoch, {k: round(v, 4) for k, v in val.items()},
+                    self.best_score, self.best_epoch,
+                )
+            if (epoch + 1) % cfg.train.save_checkpoint_every == 0:
+                ckpt.save_checkpoint(
+                    os.path.join(self.workdir, "last"),
+                    self.state,
+                    {"epoch": epoch, "history": entry},
+                )
+            self.history[str(epoch)] = entry
+            with open(
+                os.path.join(self.workdir, cfg.train.history_file), "w"
+            ) as f:
+                json.dump(self.history, f, indent=2)
+            if (
+                self.val_ds is not None
+                and cfg.train.max_patience > 0
+                and patience >= cfg.train.max_patience
+            ):
+                log.info("early stop at epoch %d", epoch)
+                break
+        return self.history

@@ -1,0 +1,236 @@
+"""Data layer tests: vocab round-trip, batch shapes/determinism, host
+sharding, h5 round-trip through the prep tool, consensus weights."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data import (
+    BatchIterator,
+    H5Dataset,
+    Vocabulary,
+    decode_sequence,
+    make_synthetic_dataset,
+)
+from cst_captioning_tpu.data.loader import subsample_frames
+from cst_captioning_tpu.models.captioner import BOS_ID, EOS_ID, PAD_ID, UNK_ID
+from cst_captioning_tpu.tools.prepare_data import (
+    consensus_weights,
+    prepare,
+)
+
+
+class TestVocabulary:
+    def test_build_encode_decode_roundtrip(self):
+        vocab = Vocabulary.build([["a", "cat", "runs"], ["a", "dog", "runs"]])
+        ids = vocab.encode(["a", "cat", "runs"], max_len=5)
+        assert ids[0] == BOS_ID
+        assert list(ids).count(EOS_ID) == 1
+        assert vocab.decode(ids) == "a cat runs"
+
+    def test_unk_for_oov(self):
+        vocab = Vocabulary.build([["cat"]])
+        ids = vocab.encode(["dog"], max_len=3)
+        assert ids[1] == UNK_ID
+
+    def test_min_freq_threshold(self):
+        vocab = Vocabulary.build([["a", "a", "rare"]], min_freq=2)
+        assert "a" in vocab and "rare" not in vocab
+
+    def test_truncation(self):
+        vocab = Vocabulary.build([["w"]])
+        ids = vocab.encode(["w"] * 10, max_len=4)
+        assert ids.shape == (6,)
+        assert ids[5] == EOS_ID
+
+    def test_save_load(self, tmp_path):
+        vocab = Vocabulary.build([["x", "y", "z"]])
+        p = str(tmp_path / "vocab.json")
+        vocab.save(p)
+        v2 = Vocabulary.load(p)
+        assert v2.idx_to_word == vocab.idx_to_word
+
+    def test_deterministic_order(self):
+        v1 = Vocabulary.build([["b", "a", "a"]])
+        v2 = Vocabulary.build([["a", "b", "a"]])
+        assert v1.idx_to_word == v2.idx_to_word
+
+
+class TestSynthetic:
+    def test_learnable_structure(self):
+        ds, vocab = make_synthetic_dataset(num_videos=10, seed=3)
+        assert len(ds) == 10
+        # refs of one video share the topic bigram
+        refs = ds.references(0)
+        head = " ".join(refs[0].split()[:2])
+        assert all(r.startswith(head) for r in refs)
+        caps = ds.captions(0)
+        assert caps.dtype == np.int32
+        assert (caps[:, 0] == BOS_ID).all()
+        assert decode_sequence(vocab, caps)[0] == refs[0]
+
+
+class TestBatchIterator:
+    def _it(self, **kw):
+        ds, _ = make_synthetic_dataset(num_videos=21, max_frames=6, seed=0)
+        defaults = dict(
+            dataset=ds, batch_size=8, seq_per_img=3, max_frames=6,
+            shuffle=True, seed=1,
+        )
+        defaults.update(kw)
+        return ds, BatchIterator(**defaults)
+
+    def test_fixed_shapes_incl_final_batch(self):
+        ds, it = self._it(drop_last=False)
+        batches = list(it.epoch(0))
+        assert len(batches) == 3  # ceil(21/8)
+        for b in batches:
+            assert b.feats["resnet"].shape == (8, 6, 64)
+            assert b.feat_masks["resnet"].shape == (8, 6)
+            assert b.captions.shape == (8, 3, 12)
+            assert b.weights.shape == (8, 3)
+            assert b.category.shape == (8,)
+            assert len(b.video_ids) == 8
+
+    def test_drop_last(self):
+        _, it = self._it(drop_last=True)
+        assert it.num_batches() == 2
+        assert len(list(it.epoch(0))) == 2
+
+    def test_epoch_determinism_and_reshuffle(self):
+        _, it = self._it()
+        a1 = [b.video_idx.tolist() for b in it.epoch(0)]
+        a2 = [b.video_idx.tolist() for b in it.epoch(0)]
+        b1 = [b.video_idx.tolist() for b in it.epoch(1)]
+        assert a1 == a2
+        assert a1 != b1
+
+    def test_covers_all_videos(self):
+        _, it = self._it(drop_last=False)
+        seen = set()
+        for b in it.epoch(0):
+            seen.update(b.video_idx.tolist())
+        assert seen == set(range(21))
+
+    def test_host_sharding_partitions(self):
+        ds, _ = make_synthetic_dataset(num_videos=21, max_frames=6, seed=0)
+        seen = []
+        for shard in range(2):
+            it = BatchIterator(
+                ds, batch_size=4, seq_per_img=2, max_frames=6,
+                shuffle=False, shard_id=shard, num_shards=2,
+            )
+            s = set()
+            for b in it.epoch(0):
+                s.update(b.video_idx.tolist())
+            seen.append(s)
+        assert seen[0] | seen[1] == set(range(21))
+        assert seen[0] & seen[1] == set()
+
+    def test_frame_mask_matches_padding(self):
+        ds, it = self._it(shuffle=False)
+        b = next(iter(it.epoch(0)))
+        fm = b.feat_masks["resnet"]
+        feats = b.feats["resnet"]
+        # padded frames are exactly zero
+        assert np.allclose(feats[fm == 0], 0.0)
+        # each video has at least one valid frame
+        assert (fm.sum(1) >= 1).all()
+
+    def test_subsample_frames(self):
+        fr = np.arange(20, dtype=np.float32)[:, None]
+        out = subsample_frames(fr, 5)
+        assert out.shape == (5, 1)
+        assert out[0, 0] == 0 and out[-1, 0] == 19
+        same = subsample_frames(fr, 30)
+        assert same.shape == (20, 1)
+
+
+class TestConsensusWeights:
+    def test_consensus_prefers_agreeing_caption(self):
+        toks = [
+            ["a", "cat", "runs"],
+            ["a", "cat", "runs", "fast"],
+            ["purple", "quantum", "xylophone"],
+        ]
+        w = consensus_weights(toks, normalize=False)
+        assert w[0] > w[2] and w[1] > w[2]
+
+    def test_normalized_mean_one(self):
+        toks = [["a", "b"], ["a", "c"], ["a", "d"]]
+        w = consensus_weights(toks)
+        np.testing.assert_allclose(w.mean(), 1.0, rtol=1e-6)
+
+    def test_single_caption_gets_one(self):
+        np.testing.assert_array_equal(
+            consensus_weights([["solo"]]), np.ones(1, np.float32)
+        )
+
+
+class TestPrepareAndH5:
+    @pytest.fixture()
+    def raw(self, tmp_path):
+        data = {
+            "videos": [
+                {"video_id": f"v{i}", "split": "train" if i < 4 else "test",
+                 "category": i % 3}
+                for i in range(6)
+            ],
+            "sentences": [
+                {"video_id": f"v{i}", "caption": c}
+                for i in range(6)
+                for c in (f"a cat number {i} runs", f"the cat {i} is running",
+                          "a dog sleeps")
+            ],
+        }
+        p = tmp_path / "videodatainfo.json"
+        p.write_text(json.dumps(data))
+        return str(p)
+
+    def test_prepare_msrvtt_roundtrip(self, raw, tmp_path):
+        out = str(tmp_path / "out")
+        paths = prepare(raw, "msrvtt", out, min_freq=1, max_words=8)
+        assert os.path.exists(paths["vocab"])
+        assert os.path.exists(paths["idf"])
+        vocab = Vocabulary.load(paths["vocab"])
+        ds = H5Dataset(
+            paths["labels_train"], {}, vocab
+        )
+        assert len(ds) == 4
+        caps = ds.captions(0)
+        assert caps.shape[1] == 10  # max_words + BOS/EOS
+        assert (caps[:, 0] == BOS_ID).all()
+        refs = ds.references(0)
+        assert len(refs) == 3
+        w = ds.caption_weights(0)
+        assert w.shape == (3,)
+        # the two agreeing cat captions outweigh the dog caption
+        assert w[0] > w[2] and w[1] > w[2]
+        assert ds.category(2) == 2
+        # cocofmt structure
+        with open(paths["cocofmt_test"]) as f:
+            coco = json.load(f)
+        assert {im["id"] for im in coco["images"]} == {"v4", "v5"}
+        assert all("caption" in a for a in coco["annotations"])
+
+    def test_h5_dataset_with_features(self, raw, tmp_path):
+        h5py = pytest.importorskip("h5py")
+        out = str(tmp_path / "out")
+        paths = prepare(raw, "msrvtt", out, min_freq=1, max_words=8)
+        featfile = str(tmp_path / "resnet.h5")
+        rng = np.random.RandomState(0)
+        with h5py.File(featfile, "w") as f:
+            for i in range(6):
+                f.create_dataset(f"v{i}", data=rng.randn(7, 16).astype("f4"))
+        vocab = Vocabulary.load(paths["vocab"])
+        ds = H5Dataset(paths["labels_train"], {"resnet": featfile}, vocab)
+        assert ds.feature_dims == {"resnet": 16}
+        f0 = ds.features(0)
+        assert f0["resnet"].shape == (7, 16)
+        it = BatchIterator(ds, batch_size=2, seq_per_img=2, max_frames=4,
+                           shuffle=False)
+        b = next(iter(it.epoch(0)))
+        assert b.feats["resnet"].shape == (2, 4, 16)
+        ds.close()
