@@ -1,0 +1,11 @@
+"""CLI entry points mirroring the reference's ``python train.py`` /
+``python test.py`` drivers (SURVEY.md §2 L6):
+
+  python -m cst_captioning_tpu.cli.train --preset msvd_resnet_xe [...]
+  python -m cst_captioning_tpu.cli.test  --preset msrvtt_eval_beam5 \\
+      --checkpoint path/to/ckpt [...]
+
+Flags are the ``--section.field`` bridge in ``config.py`` (flag-for-flag
+parity with ``opts.py``), plus ``--preset`` / ``--config`` layering which
+replaces the reference Makefile's variable stacking.
+"""

@@ -1,0 +1,62 @@
+"""Evaluation CLI — the reference's ``test.py`` (SURVEY.md §3.3):
+checkpoint -> beam decode -> predictions.json + scores.json.
+
+  python -m cst_captioning_tpu.cli.test --preset msrvtt_eval_beam5 \\
+      --checkpoint checkpoints/msrvtt_cst_ms_scb/best \\
+      [--eval.eval_split test] [--eval.out_dir eval_out]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+import jax
+
+from cst_captioning_tpu.config import parse_cli
+from cst_captioning_tpu.data.build import build_dataset
+from cst_captioning_tpu.evaluation import evaluate_dataset
+from cst_captioning_tpu.models.captioner import model_from_config
+from cst_captioning_tpu.training.checkpoint import restore_params
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--checkpoint", required=True)
+    known, rest = parser.parse_known_args(argv)
+    cfg = parse_cli(rest)
+
+    ds, vocab = build_dataset(cfg, cfg.eval.eval_split)
+    if cfg.model.vocab_size == 0:
+        cfg.model.vocab_size = len(vocab)
+    model = model_from_config(cfg)
+    # Template params (shapes only) for the orbax restore.
+    import numpy as np
+
+    feats = {
+        m: jax.numpy.zeros((1, cfg.data.max_frames, dim))
+        for m, dim in cfg.data.feature_dims.items()
+    }
+    masks = {m: jax.numpy.ones((1, cfg.data.max_frames)) for m in feats}
+    ids = jax.numpy.zeros((1, 2), jax.numpy.int32)
+    cat = jax.numpy.zeros((1,), jax.numpy.int32) if cfg.model.use_category else None
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), feats, masks, ids,
+                           category=cat)
+    )
+    template = jax.tree.map(
+        lambda s: np.zeros(s.shape, s.dtype), template
+    )
+    params = restore_params(known.checkpoint, template)
+    scores, _ = evaluate_dataset(
+        model, params, ds, cfg, out_dir=cfg.eval.out_dir
+    )
+    for k, v in scores.items():
+        print(f"{k}: {v:.4f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+"""Training CLI — the reference's ``train.py`` entry (SURVEY.md §3.1/3.2).
+
+Example (stage 1 of the paper's pipeline):
+  python -m cst_captioning_tpu.cli.train --preset msrvtt_resnet_c3d_xe \\
+      --data.label_file data/msrvtt/labels_{split}.h5 \\
+      --data.vocab_file data/msrvtt/vocab.json \\
+      --data.feature_files '{"resnet": "r.h5", "c3d": "c.h5"}'
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from cst_captioning_tpu.config import parse_cli
+from cst_captioning_tpu.data.build import build_dataset
+from cst_captioning_tpu.training.trainer import Trainer
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    cfg = parse_cli(argv)
+    train_ds, vocab = build_dataset(cfg, "train")
+    try:
+        val_ds, _ = build_dataset(cfg, "val", vocab=vocab)
+    except (KeyError, FileNotFoundError, ValueError):
+        logging.warning("no val split found — training without validation")
+        val_ds = None
+    trainer = Trainer(cfg, train_ds=train_ds, val_ds=val_ds)
+    trainer.fit()
+    logging.info(
+        "done: best val score %.4f (epoch %d), checkpoints in %s",
+        trainer.best_score, trainer.best_epoch, trainer.workdir,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

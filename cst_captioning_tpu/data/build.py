@@ -1,0 +1,43 @@
+"""Config -> dataset construction (the reference wires this inline in
+``train.py``/``test.py`` from ``opts.py`` path flags)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+from cst_captioning_tpu.config import Config
+from cst_captioning_tpu.data.datasets import (
+    CaptionDataset,
+    H5Dataset,
+    make_synthetic_dataset,
+)
+from cst_captioning_tpu.data.vocab import Vocabulary
+
+
+def build_dataset(
+    cfg: Config, split: str, vocab: Optional[Vocabulary] = None
+) -> Tuple[CaptionDataset, Vocabulary]:
+    """Build one split.  ``data.dataset == "synthetic"`` generates the toy
+    corpus (split names map to different seeds so train/val differ);
+    otherwise ``data.label_file`` is a path template with a ``{split}``
+    placeholder (as written by ``tools/prepare_data.py``) or a literal
+    path, and ``data.feature_files`` maps modality -> feature h5."""
+    d = cfg.data
+    if d.dataset == "synthetic":
+        seed = {"train": 0, "val": 1, "test": 2}.get(split, 3)
+        ds, vb = make_synthetic_dataset(
+            num_videos=max(d.batch_size * 2, 16),
+            feature_dims=dict(d.feature_dims),
+            max_frames=d.max_frames,
+            max_words=d.max_seq_len - 2,
+            num_categories=d.num_categories if cfg.model.use_category else 0,
+            seed=seed,
+        )
+        return ds, (vocab or vb)
+    if vocab is None:
+        if not d.vocab_file:
+            raise ValueError("data.vocab_file is required for h5 datasets")
+        vocab = Vocabulary.load(d.vocab_file)
+    label = d.label_file.format(split=split)
+    ds = H5Dataset(label, dict(d.feature_files), vocab)
+    return ds, vocab

@@ -1,0 +1,14 @@
+"""Decoding layer: fixed-shape beam search (reference ``sample.py``).
+
+Greedy and multinomial sampling live on the model itself
+(``CaptionModel.sample``); beam search composes the model's
+``init_decode`` / ``decode_one`` hooks into a ``lax.scan`` with a static
+beam dimension — no dynamic shapes, runs under ``jit``/``pjit``
+(SURVEY.md §7 hard part #2).
+"""
+
+from cst_captioning_tpu.decoding.beam import (  # noqa: F401
+    BeamResult,
+    beam_search,
+    make_beam_search_fn,
+)

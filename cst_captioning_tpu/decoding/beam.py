@@ -1,0 +1,151 @@
+"""Fixed-shape batched beam search.
+
+Reference equivalent: ``sample.py`` / ``sample_beam`` (SURVEY.md §2 "Beam
+search", §3.3) — beam≈5 decode keeping per-beam log-probs, end-token
+collapse, length handling.
+
+TPU-first design (NOT the reference's per-video Python loop):
+* The whole search is one ``lax.scan`` of exactly ``max_len`` steps over a
+  static ``(B, K)`` beam grid; every video in the batch is decoded
+  simultaneously.
+* Finished beams are "frozen": their token distribution collapses to PAD
+  at zero cost, so they ride along in the grid and stay comparable — no
+  dynamic beam removal (the reference pops finished beams from a list).
+* Beam reordering is a gather on the flat ``B*K`` axis of the LSTM state;
+  hypothesis tokens are carried in a pre-allocated ``(B, K, L)`` buffer
+  updated with ``dynamic_update_index_in_dim`` — all shapes static.
+* Length normalization (divide by token count) is applied once at
+  finalize, matching the common beam length-penalty choice; toggleable via
+  ``length_normalize`` (``EvalConfig.length_normalize``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.models.captioner import CaptionModel
+
+NEG_INF = -1e30
+
+
+class BeamResult(NamedTuple):
+    tokens: jax.Array       # (B, L) int32 — best hypothesis per video
+    score: jax.Array        # (B,) float32 — its (normalized) log-prob
+    all_tokens: jax.Array   # (B, K, L) int32 — full beam, best-first
+    all_scores: jax.Array   # (B, K) float32
+
+
+def beam_search(
+    model: CaptionModel,
+    params,
+    feats,
+    feat_masks,
+    *,
+    category=None,
+    beam_size: int = 5,
+    max_len: int = 30,
+    length_normalize: bool = True,
+) -> BeamResult:
+    """Run beam search for a batch of videos.  Pure function of arrays —
+    safe to wrap in ``jit`` (see :func:`make_beam_search_fn`)."""
+    K = beam_size
+    state, cache = model.apply(
+        params, feats, feat_masks, category, method="init_decode"
+    )
+    B = state.h.shape[1]
+    V = model.vocab_size
+
+    # Expand every per-video tensor to the flat (B*K) beam axis.
+    state = state._replace(
+        h=jnp.repeat(state.h, K, axis=1), c=jnp.repeat(state.c, K, axis=1)
+    )
+    cache = jax.tree.map(lambda x: jnp.repeat(x, K, axis=0), cache)
+
+    seqs0 = jnp.full((B, K, max_len), PAD_ID, jnp.int32)
+    # Only beam 0 is live at t=0 (all beams start identical).
+    scores0 = jnp.where(
+        jnp.arange(K)[None, :] == 0, 0.0, NEG_INF
+    ) * jnp.ones((B, 1))
+    finished0 = jnp.zeros((B, K), bool)
+    tokens0 = jnp.full((B * K,), BOS_ID, jnp.int32)
+
+    def step(carry, t):
+        state, seqs, scores, finished, tokens = carry
+        state, logp = model.apply(
+            params, state, cache, tokens, method="decode_one"
+        )  # logp: (B*K, V) float32
+        logp = logp.reshape(B, K, V)
+        # decode_one already masks PAD/BOS out of the policy (EOS is the
+        # only terminator).
+        # Frozen finished beams: only PAD continuation, at zero cost.
+        pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
+        logp = jnp.where(finished[..., None], pad_only[None, None, :], logp)
+        total = scores[..., None] + logp                     # (B, K, V)
+        top_scores, top_flat = jax.lax.top_k(
+            total.reshape(B, K * V), K
+        )                                                     # (B, K)
+        parent = top_flat // V                                # (B, K)
+        tok = (top_flat % V).astype(jnp.int32)                # (B, K)
+
+        batch_ix = jnp.arange(B)[:, None]
+        seqs = seqs[batch_ix, parent]                          # reorder history
+        seqs = jax.lax.dynamic_update_index_in_dim(
+            seqs, tok, t, axis=2
+        )
+        finished = finished[batch_ix, parent] | (tok == EOS_ID) | (tok == PAD_ID)
+        flat_parent = (batch_ix * K + parent).reshape(-1)      # (B*K,)
+        state = state._replace(
+            h=state.h[:, flat_parent], c=state.c[:, flat_parent]
+        )
+        # Finished beams feed EOS so the next-step embedding is defined.
+        next_tok = jnp.where(tok == PAD_ID, EOS_ID, tok).reshape(-1)
+        return (state, seqs, top_scores, finished, next_tok), None
+
+    (state, seqs, scores, finished, _), _ = jax.lax.scan(
+        step,
+        (state, seqs0, scores0, finished0, tokens0),
+        jnp.arange(max_len),
+    )
+
+    if length_normalize:
+        lengths = jnp.maximum((seqs != PAD_ID).sum(-1), 1)     # (B, K)
+        final = scores / lengths.astype(jnp.float32)
+    else:
+        final = scores
+    order = jnp.argsort(-final, axis=-1)                       # best-first
+    batch_ix = jnp.arange(B)[:, None]
+    all_tokens = seqs[batch_ix, order]
+    all_scores = final[batch_ix, order]
+    return BeamResult(
+        tokens=all_tokens[:, 0],
+        score=all_scores[:, 0],
+        all_tokens=all_tokens,
+        all_scores=all_scores,
+    )
+
+
+def make_beam_search_fn(
+    model: CaptionModel,
+    beam_size: int,
+    max_len: int,
+    length_normalize: bool = True,
+) -> Callable:
+    """Jitted ``(params, feats, feat_masks, category) -> BeamResult``."""
+
+    def fn(params, feats, feat_masks, category=None):
+        return beam_search(
+            model,
+            params,
+            feats,
+            feat_masks,
+            category=category,
+            beam_size=beam_size,
+            max_len=max_len,
+            length_normalize=length_normalize,
+        )
+
+    return jax.jit(fn)

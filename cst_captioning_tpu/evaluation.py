@@ -1,0 +1,115 @@
+"""Evaluation driver — the reference's ``test.py`` (SURVEY.md §3.3):
+load checkpoint -> beam-decode the split -> write cocofmt predictions
+json -> run the metric suite -> write scores json.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional, Tuple
+
+import jax
+import numpy as np
+
+from cst_captioning_tpu.config import Config
+from cst_captioning_tpu.data.datasets import CaptionDataset
+from cst_captioning_tpu.data.loader import BatchIterator
+from cst_captioning_tpu.data.vocab import decode_sequence
+from cst_captioning_tpu.decoding.beam import make_beam_search_fn
+from cst_captioning_tpu.metrics.evaluator import language_eval
+from cst_captioning_tpu.models.captioner import CaptionModel
+
+
+def decode_dataset(
+    ds: CaptionDataset,
+    cfg: Config,
+    decode_fn,
+    use_category: bool,
+) -> Dict[str, str]:
+    """Decode every video once -> {video_id: caption}.
+
+    ``decode_fn(feats, feat_masks, category|None) -> tokens (B, L)`` — the
+    greedy sampler during training validation, the beam searcher at test
+    time.  Shared batching: seq_per_img=1, no shuffle, wrap-around
+    duplicates collapse via the dict keying.
+    """
+    it = BatchIterator(
+        ds,
+        batch_size=cfg.data.batch_size,
+        seq_per_img=1,
+        max_frames=cfg.data.max_frames,
+        shuffle=False,
+        drop_last=False,
+    )
+    preds: Dict[str, str] = {}
+    for batch in it.epoch(0):
+        cat = jax.numpy.asarray(batch.category) if use_category else None
+        tokens = decode_fn(
+            {m: jax.numpy.asarray(v) for m, v in batch.feats.items()},
+            {m: jax.numpy.asarray(v) for m, v in batch.feat_masks.items()},
+            cat,
+        )
+        for vid, sent in zip(
+            batch.video_ids, decode_sequence(ds.vocab, np.asarray(tokens))
+        ):
+            preds[vid] = sent
+    return preds
+
+
+def score_predictions(
+    ds: CaptionDataset, preds: Dict[str, str], metrics
+) -> Dict[str, float]:
+    """Assemble gts/res from the dataset's references and run the suite."""
+    gts = {ds.video_id(i): ds.references(i) for i in range(len(ds))}
+    res = {vid: [preds[vid]] for vid in gts}
+    return language_eval(gts, res, metrics=metrics)
+
+
+def beam_decode_dataset(
+    model: CaptionModel,
+    params,
+    ds: CaptionDataset,
+    cfg: Config,
+) -> Dict[str, str]:
+    """Beam-decode every video once -> {video_id: caption}."""
+    beam_fn = make_beam_search_fn(
+        model,
+        beam_size=cfg.eval.beam_size,
+        max_len=cfg.eval.max_decode_len,
+        length_normalize=cfg.eval.length_normalize,
+    )
+
+    def decode(feats, feat_masks, category):
+        return beam_fn(params, feats, feat_masks, category).tokens
+
+    return decode_dataset(ds, cfg, decode, model.use_category)
+
+
+def evaluate_dataset(
+    model: CaptionModel,
+    params,
+    ds: CaptionDataset,
+    cfg: Config,
+    out_dir: Optional[str] = None,
+) -> Tuple[Dict[str, float], Dict[str, str]]:
+    """Full eval: beam decode + metric suite (+ json artifacts).
+
+    Returns (scores, predictions).  When ``out_dir`` is set, writes
+    ``predictions.json`` (cocofmt-results style: a list of
+    {"image_id", "caption"}) and ``scores.json`` — the reference's two
+    eval artifacts.
+    """
+    preds = beam_decode_dataset(model, params, ds, cfg)
+    scores = score_predictions(ds, preds, cfg.eval.metrics)
+    if out_dir:
+        os.makedirs(out_dir, exist_ok=True)
+        with open(os.path.join(out_dir, "predictions.json"), "w") as f:
+            json.dump(
+                [{"image_id": vid, "caption": c} for vid, c in preds.items()],
+                f,
+                indent=2,
+            )
+        with open(os.path.join(out_dir, "scores.json"), "w") as f:
+            json.dump(scores, f, indent=2)
+    return scores, preds

@@ -256,6 +256,14 @@ class CaptionModel(nn.Module):
             h.astype(cdt) @ self.logit_w.astype(cdt) + self.logit_b.astype(cdt)
         ).astype(jnp.float32)
 
+    @staticmethod
+    def mask_decode_logits(logits: jax.Array) -> jax.Array:
+        """The decode-time policy never emits PAD or BOS — EOS is the only
+        terminator.  Applied identically in sampling, beam search, and the
+        CST policy-gradient likelihood (which must match the rollout
+        policy); teacher-forced XE logits stay unmasked."""
+        return logits.at[..., PAD_ID].set(-1e30).at[..., BOS_ID].set(-1e30)
+
     # --------------------------------------------------------------- forward
     def __call__(
         self,
@@ -332,9 +340,11 @@ class CaptionModel(nn.Module):
     def decode_one(
         self, state: DecodeState, cache: DecodeCache, tokens: jax.Array
     ) -> Tuple[DecodeState, jax.Array]:
-        """One decode step → (new state, float32 log-probs (B, V))."""
+        """One decode step → (new state, float32 log-probs (B, V)) under
+        the decode policy (PAD/BOS masked out)."""
         state, h_top = self._step(state, cache, tokens)
-        return state, jax.nn.log_softmax(self._logits(h_top), axis=-1)
+        logits = self.mask_decode_logits(self._logits(h_top))
+        return state, jax.nn.log_softmax(logits, axis=-1)
 
     def sample(
         self,
@@ -363,7 +373,7 @@ class CaptionModel(nn.Module):
             state, tok, finished, key = carry
             key, k = jax.random.split(key)
             state, h_top = self._step(state, cache, tok)
-            logits = self._logits(h_top)
+            logits = self.mask_decode_logits(self._logits(h_top))
             if greedy:
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)

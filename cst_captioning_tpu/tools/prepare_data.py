@@ -174,6 +174,17 @@ def prepare(
     """Run the full prep pipeline; returns the paths written."""
     os.makedirs(out_dir, exist_ok=True)
     splits, captions, categories = load_annotations(input_path, fmt)
+    missing = [
+        vid
+        for vids in splits.values()
+        for vid in vids
+        if not captions.get(vid)
+    ]
+    if missing:
+        raise ValueError(
+            f"{len(missing)} video(s) in the split lists have no captions "
+            f"(first few: {missing[:5]}) — fix the annotations before prep"
+        )
 
     tokenized: Dict[str, List[List[str]]] = {
         vid: [ptb_tokenize(c) for c in caps]

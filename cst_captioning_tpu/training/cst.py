@@ -131,10 +131,20 @@ def make_cst_train_step(
             logits = state.apply_fn(
                 params, feats_r, masks_r, inputs, category=cat_r
             )
+            # REINFORCE needs log-probs of the distribution that was
+            # actually sampled from: same PAD/BOS masking AND the same
+            # temperature scaling as the rollout policy.
+            logits = CaptionModel.mask_decode_logits(logits) / jnp.asarray(
+                temperature, jnp.float32
+            )
             logp = jax.nn.log_softmax(logits, axis=-1)
             tok_lp = jnp.take_along_axis(
                 logp, rollout.tokens[..., None], axis=-1
             )[..., 0]
+            # Post-EOS slots hold PAD (= -inf under the masked policy);
+            # zero them before the masked reduction so 0 * -inf never
+            # produces NaN.
+            tok_lp = jnp.where(rollout.mask > 0, tok_lp, 0.0)
             return reward_criterion(tok_lp, rollout.mask, advantage)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)

@@ -24,8 +24,7 @@ import numpy as np
 from cst_captioning_tpu.config import Config
 from cst_captioning_tpu.data.datasets import CaptionDataset
 from cst_captioning_tpu.data.loader import BatchIterator, prefetch_to_device
-from cst_captioning_tpu.data.vocab import Vocabulary, decode_sequence
-from cst_captioning_tpu.metrics.evaluator import language_eval
+from cst_captioning_tpu.data.vocab import Vocabulary
 from cst_captioning_tpu.models.captioner import model_from_config
 from cst_captioning_tpu.training import checkpoint as ckpt
 from cst_captioning_tpu.training.steps import (
@@ -182,37 +181,20 @@ class Trainer:
     # ---------------------------------------------------------- evaluation
     def predict(self, ds: CaptionDataset) -> Dict[str, str]:
         """Greedy-decode every video once -> {video_id: caption}."""
-        it = BatchIterator(
-            ds,
-            batch_size=self.cfg.data.batch_size,
-            seq_per_img=1,
-            max_frames=self.cfg.data.max_frames,
-            shuffle=False,
-            drop_last=False,
-        )
-        preds: Dict[str, str] = {}
-        for batch in it.epoch(0):
-            toks = self._sample_fn(
-                self.state.params,
-                {m: jax.numpy.asarray(v) for m, v in batch.feats.items()},
-                {m: jax.numpy.asarray(v) for m, v in batch.feat_masks.items()},
-                self._category(batch),
-            )
-            for vid, sent in zip(
-                batch.video_ids, decode_sequence(self.vocab, np.asarray(toks))
-            ):
-                preds[vid] = sent
-        return preds
+        from cst_captioning_tpu.evaluation import decode_dataset
+
+        def decode(feats, feat_masks, category):
+            return self._sample_fn(self.state.params, feats, feat_masks,
+                                   category)
+
+        return decode_dataset(ds, self.cfg, decode, self.model.use_category)
 
     def evaluate(self, ds: Optional[CaptionDataset] = None) -> Dict[str, float]:
+        from cst_captioning_tpu.evaluation import score_predictions
+
         ds = ds or self.val_ds
         assert ds is not None, "no validation dataset"
-        preds = self.predict(ds)
-        gts = {
-            ds.video_id(i): ds.references(i) for i in range(len(ds))
-        }
-        res = {vid: [preds[vid]] for vid in gts}
-        return language_eval(gts, res, metrics=self.cfg.eval.metrics)
+        return score_predictions(ds, self.predict(ds), self.cfg.eval.metrics)
 
     # ----------------------------------------------------------------- fit
     def fit(self) -> Dict[str, dict]:

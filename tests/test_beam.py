@@ -1,0 +1,209 @@
+"""Beam search tests: toy-vocab optimality vs exhaustive search, beam=1 ==
+greedy, ordering/monotonicity properties, eval driver artifacts, CLI."""
+
+import itertools
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.data import make_synthetic_dataset
+from cst_captioning_tpu.decoding import beam_search, make_beam_search_fn
+from cst_captioning_tpu.evaluation import evaluate_dataset
+from cst_captioning_tpu.models import CaptionModel
+
+V, B, F, D, H = 9, 3, 4, 8, 12
+
+
+def tiny_model(np_rng, **kw):
+    kwargs = dict(
+        vocab_size=V, rnn_size=H, num_layers=1, embed_size=H,
+        modalities=("resnet",), feature_dims=(D,), drop_prob=0.0,
+        compute_dtype="float32",
+    )
+    kwargs.update(kw)
+    model = CaptionModel(**kwargs)
+    feats = {"resnet": jnp.asarray(np_rng.randn(B, F, D), jnp.float32)}
+    masks = {"resnet": jnp.ones((B, F))}
+    ids = jnp.asarray(np_rng.randint(4, V, (B, 5)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), feats, masks, ids)
+    return model, params, feats, masks
+
+
+@pytest.fixture(scope="module")
+def np_rng():
+    return np.random.RandomState(11)
+
+
+def exhaustive_best(model, params, feats, masks, max_len, length_normalize):
+    """Brute-force optimum over all sequences of length <= max_len on the
+    tiny vocab (words 4..V-1 + EOS), scoring with the model's own
+    decode_one chain."""
+    state0, cache = model.apply(params, feats, masks, method="init_decode")
+
+    def seq_logprob(seq, b):
+        state = jax.tree.map(lambda x: x[:, b : b + 1] if x.ndim == 3 else x,
+                             state0)
+        cache_b = jax.tree.map(lambda x: x[b : b + 1], cache)
+        tok = jnp.full((1,), BOS_ID, jnp.int32)
+        total = 0.0
+        for s in seq:
+            state, logp = model.apply(
+                params, state, cache_b, tok, method="decode_one"
+            )
+            total += float(logp[0, s])
+            tok = jnp.full((1,), s, jnp.int32)
+        return total
+
+    best = []
+    words = list(range(3, V))  # UNK + real words (beam may emit UNK)
+    for b in range(B):
+        cands = []
+        for n in range(0, max_len):  # n words + EOS (n=0: empty caption)
+            for combo in itertools.product(words, repeat=n):
+                seq = list(combo) + [EOS_ID]
+                lp = seq_logprob(seq, b)
+                norm = lp / len(seq) if length_normalize else lp
+                cands.append((norm, seq))
+        # sequences with no EOS (full length, no terminator)
+        for combo in itertools.product(words, repeat=max_len):
+            lp = seq_logprob(list(combo), b)
+            norm = lp / max_len if length_normalize else lp
+            cands.append((norm, list(combo)))
+        cands.sort(key=lambda x: -x[0])
+        best.append(cands[0])
+    return best
+
+
+class TestBeamSearch:
+    def test_shapes_and_jit(self, np_rng):
+        model, params, feats, masks = tiny_model(np_rng)
+        fn = make_beam_search_fn(model, beam_size=4, max_len=6)
+        r = fn(params, feats, masks)
+        assert r.tokens.shape == (B, 6)
+        assert r.score.shape == (B,)
+        assert r.all_tokens.shape == (B, 4, 6)
+        assert r.all_scores.shape == (B, 4)
+
+    def test_scores_sorted_best_first(self, np_rng):
+        model, params, feats, masks = tiny_model(np_rng)
+        r = beam_search(model, params, feats, masks, beam_size=4, max_len=6)
+        s = np.asarray(r.all_scores)
+        assert (np.diff(s, axis=1) <= 1e-6).all()
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens), np.asarray(r.all_tokens[:, 0])
+        )
+
+    def test_beam1_equals_greedy(self, np_rng):
+        model, params, feats, masks = tiny_model(np_rng)
+        r = beam_search(
+            model, params, feats, masks, beam_size=1, max_len=6,
+            length_normalize=False,
+        )
+        g = model.apply(params, feats, masks, max_len=6, method="sample")
+        np.testing.assert_array_equal(np.asarray(r.tokens), np.asarray(g.tokens))
+
+    @pytest.mark.parametrize("length_normalize", [False, True])
+    def test_wide_beam_finds_exhaustive_optimum(self, np_rng, length_normalize):
+        """With a beam as wide as the whole candidate space per step, beam
+        search must recover the true optimum on a tiny vocab, 3 steps."""
+        model, params, feats, masks = tiny_model(np_rng)
+        max_len = 3
+        r = beam_search(
+            model, params, feats, masks, beam_size=32, max_len=max_len,
+            length_normalize=length_normalize,
+        )
+        best = exhaustive_best(model, params, feats, masks, max_len,
+                               length_normalize)
+        for b in range(B):
+            got = [int(t) for t in np.asarray(r.tokens[b]) if t != PAD_ID]
+            want = [s for s in best[b][1] if s != PAD_ID]
+            # compare sequences (strip trailing EOS representation diffs)
+            got_w = [t for t in got if t != EOS_ID]
+            want_w = [t for t in want if t != EOS_ID]
+            assert got_w == want_w, f"video {b}: {got} != {want}"
+            np.testing.assert_allclose(
+                float(r.score[b]), best[b][0], rtol=1e-4
+            )
+
+    def test_after_end_only_pad(self, np_rng):
+        model, params, feats, masks = tiny_model(np_rng)
+        r = beam_search(model, params, feats, masks, beam_size=3, max_len=8)
+        toks = np.asarray(r.all_tokens).reshape(-1, 8)
+        for row in toks:
+            ends = np.nonzero((row == EOS_ID) | (row == PAD_ID))[0]
+            if len(ends):
+                assert (row[ends[0] + 1 :] == PAD_ID).all() or row[ends[0]] == EOS_ID and (
+                    row[ends[0] + 1 :] == PAD_ID
+                ).all()
+
+    def test_wider_beam_no_worse_unnormalized(self, np_rng):
+        model, params, feats, masks = tiny_model(np_rng)
+        r2 = beam_search(model, params, feats, masks, beam_size=2, max_len=5,
+                         length_normalize=False)
+        r8 = beam_search(model, params, feats, masks, beam_size=8, max_len=5,
+                         length_normalize=False)
+        assert (np.asarray(r8.score) >= np.asarray(r2.score) - 1e-5).all()
+
+
+class TestEvaluation:
+    def test_evaluate_dataset_writes_artifacts(self, tmp_path):
+        from cst_captioning_tpu.config import get_preset
+
+        ds, vocab = make_synthetic_dataset(num_videos=8, max_frames=6, seed=4)
+        cfg = get_preset("synthetic_smoke")
+        cfg.model.vocab_size = len(vocab)
+        cfg.eval.metrics = ["Bleu_4", "CIDEr"]
+        cfg.eval.beam_size = 3
+        cfg.eval.max_decode_len = 8
+        from cst_captioning_tpu.models import model_from_config
+
+        model = model_from_config(cfg)
+        feats = {"resnet": jnp.zeros((1, 6, 64))}
+        masks = {"resnet": jnp.ones((1, 6))}
+        params = model.init(
+            jax.random.PRNGKey(0), feats, masks,
+            jnp.zeros((1, 2), jnp.int32),
+        )
+        out = str(tmp_path / "eval")
+        scores, preds = evaluate_dataset(model, params, ds, cfg, out_dir=out)
+        assert set(scores) == {"Bleu_4", "CIDEr"}
+        assert len(preds) == 8
+        with open(os.path.join(out, "predictions.json")) as f:
+            pj = json.load(f)
+        assert len(pj) == 8 and {"image_id", "caption"} <= set(pj[0])
+        assert os.path.exists(os.path.join(out, "scores.json"))
+
+
+class TestCLI:
+    def test_train_then_test_cli_roundtrip(self, tmp_path):
+        from cst_captioning_tpu.cli.test import main as test_main
+        from cst_captioning_tpu.cli.train import main as train_main
+
+        ckpt_dir = str(tmp_path / "ck")
+        rc = train_main([
+            "--preset", "synthetic_smoke",
+            "--train.checkpoint_dir", ckpt_dir,
+            "--train.max_epochs", "1",
+            "--train.max_patience", "0",
+            "--eval.metrics", '["CIDEr"]',
+            "--eval.max_decode_len", "11",
+        ])
+        assert rc == 0
+        best = os.path.join(ckpt_dir, "synthetic_smoke", "best")
+        assert os.path.exists(best)
+        out = str(tmp_path / "eval_out")
+        rc = test_main([
+            "--checkpoint", best,
+            "--preset", "synthetic_smoke",
+            "--eval.metrics", '["CIDEr"]',
+            "--eval.beam_size", "3",
+            "--eval.max_decode_len", "11",
+            "--eval.out_dir", out,
+        ])
+        assert rc == 0
+        assert os.path.exists(os.path.join(out, "scores.json"))
