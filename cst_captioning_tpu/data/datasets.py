@@ -202,7 +202,6 @@ def make_synthetic_dataset(
         (rng.randint(len(_SYNTH_NOUNS)), rng.randint(len(_SYNTH_VERBS)))
         for _ in range(num_videos)
     ]
-    all_tokens: List[List[str]] = []
     per_video_refs: List[List[str]] = []
     for n_i, v_i in topics:
         refs = []
@@ -211,12 +210,19 @@ def make_synthetic_dataset(
             if r > 0:
                 words.append(_SYNTH_ADVS[(n_i + v_i + r) % len(_SYNTH_ADVS)])
             refs.append(" ".join(words))
-            all_tokens.append(words)
         per_video_refs.append(refs)
-    vocab = Vocabulary.build(all_tokens, min_freq=1)
+    # Seed-INDEPENDENT vocabulary over the full synthetic word lists: any
+    # split (train/val/test at different seeds) shares one id<->word table,
+    # so decoding val predictions with the train vocab is always correct.
+    vocab = Vocabulary(_SYNTH_NOUNS + _SYNTH_VERBS + _SYNTH_ADVS)
 
+    # Topic embeddings from a seed-independent generator so every split
+    # maps topic t to the same feature cluster.
+    topic_rng = np.random.RandomState(20260729)
     topic_embed = {
-        m: rng.randn(len(_SYNTH_NOUNS) * len(_SYNTH_VERBS), d).astype(np.float32)
+        m: topic_rng.randn(len(_SYNTH_NOUNS) * len(_SYNTH_VERBS), d).astype(
+            np.float32
+        )
         for m, d in feature_dims.items()
     }
     feats: Dict[str, List[np.ndarray]] = {m: [] for m in feature_dims}

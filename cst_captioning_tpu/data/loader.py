@@ -180,15 +180,19 @@ def prefetch_to_device(
                 continue
         return False
 
+    from cst_captioning_tpu.parallel.sharding import make_placer
+
+    _place = make_placer(sharding)
+
     def worker():
         try:
             for b in batches:
                 arrays = b._asdict()
                 put = {
-                    k: jax.device_put(v, sharding)
+                    k: _place(v)
                     if isinstance(v, (np.ndarray,))
                     else (
-                        {m: jax.device_put(a, sharding) for m, a in v.items()}
+                        {m: _place(a) for m, a in v.items()}
                         if isinstance(v, dict)
                         else v
                     )

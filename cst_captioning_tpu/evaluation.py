@@ -26,14 +26,21 @@ def decode_dataset(
     cfg: Config,
     decode_fn,
     use_category: bool,
+    sharding=None,
+    vocab=None,
 ) -> Dict[str, str]:
     """Decode every video once -> {video_id: caption}.
 
     ``decode_fn(feats, feat_masks, category|None) -> tokens (B, L)`` — the
     greedy sampler during training validation, the beam searcher at test
     time.  Shared batching: seq_per_img=1, no shuffle, wrap-around
-    duplicates collapse via the dict keying.
+    duplicates collapse via the dict keying.  ``sharding`` (the trainer's
+    data-axis batch sharding) parallelizes decode over the mesh too.
+    ``vocab`` decodes ids back to words — pass the TRAINING vocab (model
+    ids are defined by it); defaults to ``ds.vocab`` which is only correct
+    when the dataset was built with that same vocabulary.
     """
+    vocab = vocab or ds.vocab
     it = BatchIterator(
         ds,
         batch_size=cfg.data.batch_size,
@@ -42,16 +49,19 @@ def decode_dataset(
         shuffle=False,
         drop_last=False,
     )
+    from cst_captioning_tpu.parallel.sharding import make_placer
+
+    place = make_placer(sharding)
     preds: Dict[str, str] = {}
     for batch in it.epoch(0):
-        cat = jax.numpy.asarray(batch.category) if use_category else None
+        cat = place(batch.category) if use_category else None
         tokens = decode_fn(
-            {m: jax.numpy.asarray(v) for m, v in batch.feats.items()},
-            {m: jax.numpy.asarray(v) for m, v in batch.feat_masks.items()},
+            {m: place(v) for m, v in batch.feats.items()},
+            {m: place(v) for m, v in batch.feat_masks.items()},
             cat,
         )
         for vid, sent in zip(
-            batch.video_ids, decode_sequence(ds.vocab, np.asarray(tokens))
+            batch.video_ids, decode_sequence(vocab, np.asarray(tokens))
         ):
             preds[vid] = sent
     return preds

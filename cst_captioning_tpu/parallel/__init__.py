@@ -1,0 +1,35 @@
+"""Parallelism over a ``jax.sharding.Mesh``.
+
+The reference's entire device story is ``.cuda()`` + optional single-host
+``nn.DataParallel`` (SURVEY.md §2 "Parallelism strategies"); there is no
+distributed backend at all.  This package rebuilds that capability the TPU
+way and leaves headroom the reference never had:
+
+* ``data`` mesh axis — batch sharding (DP).  Gradients all-reduce over ICI
+  via the psum XLA inserts under ``jit`` when inputs are sharded batch-wise
+  and params are replicated.
+* ``model`` mesh axis — tensor-parallel sharding of the vocab-sized
+  parameters (word embedding + logit head), the only tensors in an
+  LSTM-512 captioner big enough to shard.  XLA inserts the all-gather /
+  reduce-scatter collectives from the sharding annotations.
+* Multi-host: each process feeds its own chips (``BatchIterator``'s
+  shard_id/num_shards) and ``jax.distributed`` handles DCN bootstrap; the
+  mesh spans all devices.
+
+No torch-style replicate/scatter/gather module exists here on purpose:
+sharding annotations + the compiler ARE the parallelism implementation
+(jax-ml.github.io/scaling-book's recipe: pick a mesh, annotate shardings,
+let XLA insert collectives).
+"""
+
+from cst_captioning_tpu.parallel.mesh import (  # noqa: F401
+    make_mesh,
+    mesh_from_config,
+)
+from cst_captioning_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicate,
+    shard_batch,
+    shard_params,
+    param_spec,
+)

@@ -1,0 +1,63 @@
+"""Mesh construction from config.
+
+``TrainConfig.mesh_shape`` is an ordered {axis: size} dict (e.g.
+``{"data": -1, "model": 1}``); a single ``-1`` absorbs the remaining
+devices, mirroring how the reference's DataParallel absorbed "all visible
+GPUs" — except here the axes generalize beyond DP.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+log = logging.getLogger("cst_captioning_tpu.parallel")
+
+
+def make_mesh(
+    shape: Dict[str, int], devices: Optional[Sequence] = None
+) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    sizes = dict(shape)
+    wild = [k for k, v in sizes.items() if v == -1]
+    if len(wild) > 1:
+        raise ValueError(f"at most one -1 axis allowed, got {wild}")
+    fixed = int(np.prod([v for v in sizes.values() if v != -1]))
+    if wild:
+        if n % fixed:
+            raise ValueError(
+                f"{n} devices not divisible by fixed axes product {fixed}"
+            )
+        sizes[wild[0]] = n // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total > n:
+        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+    if total < n:
+        log.warning(
+            "mesh %s uses %d of %d devices — %d chips idle",
+            sizes, total, n, n - total,
+        )
+    dims = [sizes[k] for k in sizes]
+    if total == n:
+        # ICI-topology-aware assignment: collectives on the trailing
+        # (model) axis ride adjacent links.
+        try:
+            from jax.experimental import mesh_utils
+
+            mesh_devices = mesh_utils.create_device_mesh(
+                dims, devices=devices
+            )
+            return Mesh(mesh_devices, tuple(sizes.keys()))
+        except Exception as e:  # virtual/CPU platforms lack topology info
+            log.debug("create_device_mesh failed (%s); enumeration order", e)
+    mesh_devices = np.asarray(devices[:total]).reshape(dims)
+    return Mesh(mesh_devices, tuple(sizes.keys()))
+
+
+def mesh_from_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
+    return make_mesh(dict(cfg.train.mesh_shape), devices)

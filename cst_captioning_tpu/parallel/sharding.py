@@ -1,0 +1,106 @@
+"""Sharding rules: batch over ``data``, vocab-sized params over ``model``.
+
+The annotations here are the entire parallelism "implementation": under
+``jit``, XLA GSPMD propagates them through the scan/matmuls and inserts
+the collectives (grad psum over ``data``; logit all-gather / embedding
+collective over ``model``) on the ICI mesh.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Parameter-name -> spec rules for the model axis.  The only tensors worth
+# sharding in an LSTM captioner are vocab-sized (V ~ 10-20k):
+#   word_embed (V, E) — rows sharded over model
+#   logit_w    (H, V) — columns sharded over model
+# Everything else (LSTM kernels, projections, attention MLP) is tiny and
+# replicated.  Rules are regexes over the flattened param path.
+DEFAULT_PARAM_RULES = (
+    (re.compile(r"word_embed$"), P("model", None)),
+    (re.compile(r"logit_w$"), P(None, "model")),
+    (re.compile(r"logit_b$"), P("model")),
+)
+
+
+def param_spec(path: str, rules=DEFAULT_PARAM_RULES) -> P:
+    for pat, spec in rules:
+        if pat.search(path):
+            return spec
+    return P()
+
+
+def _path_str(path) -> str:
+    return "/".join(
+        getattr(k, "key", getattr(k, "name", str(k))) for k in path
+    )
+
+
+def _divisible(x, spec: P, mesh: Mesh) -> bool:
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        if dim >= x.ndim or x.shape[dim] % mesh.shape[axis] != 0:
+            return False
+    return True
+
+
+def shard_params(params, mesh: Mesh, rules=DEFAULT_PARAM_RULES):
+    """Place params on the mesh per the rules (replicated by default).
+    With a size-1 model axis every spec degenerates to full replication —
+    plain DP — so this is safe to apply unconditionally.
+
+    A tensor whose sharded dimension doesn't divide the mesh axis falls
+    back to replication (correctness first; pad the vocab to a multiple of
+    the model axis to get the sharding benefit)."""
+
+    def place(path, x):
+        spec = param_spec(_path_str(path), rules)
+        if not _divisible(x, spec, mesh):
+            spec = P()
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map_with_path(place, params)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Leading-axis batch sharding: (B, ...) split over ``data``."""
+    return NamedSharding(mesh, P("data"))
+
+
+def replicate(tree, mesh: Mesh):
+    return jax.device_put(tree, NamedSharding(mesh, P()))
+
+
+def put_host_batch(x, sharding: NamedSharding):
+    """Place one host array with ``sharding``.
+
+    Single-process: plain ``device_put``.  Multi-process (pod slices over
+    DCN): the global mesh isn't fully addressable from one process, so the
+    host array — this process's shard of the global batch, as produced by
+    ``BatchIterator(shard_id=process_index)`` — is assembled into the
+    global array with ``jax.make_array_from_process_local_data``.
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    return jax.make_array_from_process_local_data(sharding, np.asarray(x))
+
+
+def shard_batch(tree, mesh: Mesh):
+    """Place every array leaf with leading-axis data sharding."""
+    sh = batch_sharding(mesh)
+    return jax.tree.map(lambda x: put_host_batch(x, sh), tree)
+
+
+def make_placer(sharding=None):
+    """Host-array placement closure shared by the prefetch worker and the
+    decode path: mesh-aware when a sharding is given, plain device_put
+    otherwise."""
+    if sharding is None:
+        return jax.device_put
+    return lambda x: put_host_batch(x, sharding)

@@ -81,8 +81,14 @@ def create_train_state(
     model: CaptionModel,
     tx: optax.GradientTransformation,
     sample_batch: Dict[str, Any],
+    mesh=None,
 ) -> TrainState:
-    """Initialize params from one (host) batch's shapes."""
+    """Initialize params from one (host) batch's shapes.
+
+    With a ``mesh``, parameters are placed per the tensor-parallel rules
+    (``parallel/sharding.py``) BEFORE ``tx.init`` so the Adam moments
+    inherit each param's sharding.
+    """
     feats = {m: jnp.asarray(v[:1]) for m, v in sample_batch["feats"].items()}
     masks = {
         m: jnp.asarray(v[:1]) for m, v in sample_batch["feat_masks"].items()
@@ -94,6 +100,10 @@ def create_train_state(
         else None
     )
     params = model.init(rng, feats, masks, ids, category=cat)
+    if mesh is not None:
+        from cst_captioning_tpu.parallel.sharding import shard_params
+
+        params = shard_params(params, mesh)
     return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
 

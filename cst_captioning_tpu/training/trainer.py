@@ -91,11 +91,33 @@ class Trainer:
         steps_per_epoch = max(1, self.train_iter.num_batches())
         self.tx = make_optimizer(cfg.train, steps_per_epoch)
 
+        # Device mesh (reference: .cuda()/DataParallel only).  A single
+        # device degenerates to no mesh; otherwise params go on the mesh
+        # per the TP rules and batches are sharded over the data axis.
+        if len(jax.devices()) > 1:
+            from cst_captioning_tpu.parallel import (
+                batch_sharding,
+                mesh_from_config,
+            )
+
+            self.mesh = mesh_from_config(cfg)
+            data_ways = self.mesh.shape.get("data", 1)
+            if cfg.data.batch_size % data_ways:
+                raise ValueError(
+                    f"data.batch_size={cfg.data.batch_size} must be "
+                    f"divisible by the data mesh axis ({data_ways}) — "
+                    "sharded batches require even splits"
+                )
+            self._batch_sharding = batch_sharding(self.mesh)
+        else:
+            self.mesh = None
+            self._batch_sharding = None
+
         rng = jax.random.PRNGKey(cfg.train.seed)
         self.rng, init_rng = jax.random.split(rng)
         first = next(iter(self.train_iter.epoch(0)))
         self.state = create_train_state(
-            init_rng, self.model, self.tx, first._asdict()
+            init_rng, self.model, self.tx, first._asdict(), mesh=self.mesh
         )
         if cfg.train.start_from:
             log.info("warm start from %s", cfg.train.start_from)
@@ -140,7 +162,9 @@ class Trainer:
         acc: Dict[str, List[jax.Array]] = {}
         t0 = time.time()
         nsteps = 0
-        for batch in prefetch_to_device(self.train_iter.epoch(epoch)):
+        for batch in prefetch_to_device(
+            self.train_iter.epoch(epoch), sharding=self._batch_sharding
+        ):
             self.rng, step_rng = jax.random.split(self.rng)
             weights = (
                 batch.weights
@@ -187,7 +211,10 @@ class Trainer:
             return self._sample_fn(self.state.params, feats, feat_masks,
                                    category)
 
-        return decode_dataset(ds, self.cfg, decode, self.model.use_category)
+        return decode_dataset(
+            ds, self.cfg, decode, self.model.use_category,
+            sharding=self._batch_sharding, vocab=self.vocab,
+        )
 
     def evaluate(self, ds: Optional[CaptionDataset] = None) -> Dict[str, float]:
         from cst_captioning_tpu.evaluation import score_predictions

@@ -137,9 +137,7 @@ class TestBeamSearch:
         for row in toks:
             ends = np.nonzero((row == EOS_ID) | (row == PAD_ID))[0]
             if len(ends):
-                assert (row[ends[0] + 1 :] == PAD_ID).all() or row[ends[0]] == EOS_ID and (
-                    row[ends[0] + 1 :] == PAD_ID
-                ).all()
+                assert (row[ends[0] + 1 :] == PAD_ID).all()
 
     def test_wider_beam_no_worse_unnormalized(self, np_rng):
         model, params, feats, masks = tiny_model(np_rng)

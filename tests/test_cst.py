@@ -67,7 +67,7 @@ class TestRewarder:
 
 def cst_cfg(tmp_path, baseline, **over):
     cfg = get_preset("synthetic_smoke")
-    cfg.data.batch_size = 6
+    cfg.data.batch_size = 8
     cfg.data.seq_per_img = 2
     cfg.data.max_frames = 6
     cfg.data.max_seq_len = 11  # captions(0).shape[1]-1 (decode len)
@@ -87,7 +87,7 @@ def cst_cfg(tmp_path, baseline, **over):
 
 def xe_pretrain(ds, tmp_path, epochs=60):
     cfg = get_preset("synthetic_smoke")
-    cfg.data.batch_size = 12
+    cfg.data.batch_size = 8
     cfg.data.seq_per_img = 3
     cfg.data.max_frames = 6
     cfg.train.checkpoint_dir = str(tmp_path / "xe")

@@ -59,6 +59,28 @@ class TestVocabulary:
 
 
 class TestSynthetic:
+    def test_vocab_is_seed_independent(self):
+        """Regression: train/val/test synthetic splits (different seeds)
+        must share one id<->word table, or decoding val predictions with
+        the train vocab mistranslates every caption."""
+        _, v0 = make_synthetic_dataset(num_videos=8, seed=0)
+        _, v1 = make_synthetic_dataset(num_videos=8, seed=1)
+        assert v0.idx_to_word == v1.idx_to_word
+
+    def test_topic_features_are_seed_independent(self):
+        ds0, _ = make_synthetic_dataset(num_videos=30, seed=0, noise=0.0)
+        ds1, _ = make_synthetic_dataset(num_videos=30, seed=1, noise=0.0)
+        # find two videos with the same topic caption across seeds
+        for i in range(len(ds0)):
+            for j in range(len(ds1)):
+                if ds0.references(i)[0] == ds1.references(j)[0]:
+                    np.testing.assert_allclose(
+                        ds0.features(i)["resnet"][0],
+                        ds1.features(j)["resnet"][0],
+                    )
+                    return
+        pytest.skip("no shared topic between seeds")
+
     def test_learnable_structure(self):
         ds, vocab = make_synthetic_dataset(num_videos=10, seed=3)
         assert len(ds) == 10

@@ -1,0 +1,192 @@
+"""Parallel layer tests on the 8-device virtual CPU mesh (SURVEY.md §4
+"Distributed"): mesh construction, DP grad equivalence vs single device,
+TP param sharding, trainer integration, CST-under-mesh."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cst_captioning_tpu.config import get_preset
+from cst_captioning_tpu.data import BatchIterator, make_synthetic_dataset
+from cst_captioning_tpu.parallel import (
+    batch_sharding,
+    make_mesh,
+    mesh_from_config,
+    param_spec,
+    shard_batch,
+    shard_params,
+)
+from cst_captioning_tpu.training import Trainer
+from cst_captioning_tpu.training.steps import (
+    create_train_state,
+    make_optimizer,
+    make_xe_train_step,
+)
+from cst_captioning_tpu.models import model_from_config
+
+
+def _params_allclose(a, b, rtol=2e-5, atol=1e-5):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_allclose(
+            np.asarray(x), np.asarray(y), rtol=rtol, atol=atol
+        ),
+        a,
+        b,
+    )
+
+
+class TestMesh:
+    def test_wildcard_absorbs_devices(self):
+        mesh = make_mesh({"data": -1, "model": 1})
+        assert mesh.shape == {"data": 8, "model": 1}
+
+    def test_explicit_shape(self):
+        mesh = make_mesh({"data": 2, "model": 4})
+        assert mesh.shape == {"data": 2, "model": 4}
+
+    def test_errors(self):
+        with pytest.raises(ValueError):
+            make_mesh({"data": -1, "model": -1})
+        with pytest.raises(ValueError):
+            make_mesh({"data": 16})
+        with pytest.raises(ValueError):
+            make_mesh({"data": -1, "model": 3})
+
+    def test_from_config(self):
+        cfg = get_preset("synthetic_smoke")
+        mesh = mesh_from_config(cfg)
+        assert mesh.shape == {"data": 8, "model": 1}
+
+    def test_param_spec_rules(self):
+        assert param_spec("params/word_embed") == P("model", None)
+        assert param_spec("params/logit_w") == P(None, "model")
+        assert param_spec("params/lstm0_w") == P()
+
+
+def _setup(cfg, vocab_multiple=1):
+    ds, _ = make_synthetic_dataset(
+        num_videos=16, max_frames=cfg.data.max_frames, seed=3
+    )
+    # Pad the vocab dimension up to a multiple (TP sharding needs the
+    # vocab-sized tensors divisible by the model axis).
+    v = len(ds.vocab)
+    cfg.model.vocab_size = ((v + vocab_multiple - 1) // vocab_multiple
+                            * vocab_multiple)
+    it = BatchIterator(
+        ds, batch_size=8, seq_per_img=2, max_frames=cfg.data.max_frames,
+        shuffle=False,
+    )
+    batch = next(iter(it.epoch(0)))
+    model = model_from_config(cfg)
+    tx = make_optimizer(cfg.train, 10)
+    return ds, model, tx, batch
+
+
+class TestDPEquivalence:
+    def test_sharded_step_matches_single_device(self):
+        cfg = get_preset("synthetic_smoke")
+        ds, model, tx, batch = _setup(cfg)
+        rng = jax.random.PRNGKey(0)
+        step_rng = jax.random.PRNGKey(1)
+
+        # Single device (mesh over devices[:1]).
+        s1 = create_train_state(rng, model, tx, batch._asdict())
+        step = make_xe_train_step(model)
+        ones = jnp.ones_like(jnp.asarray(batch.weights))
+        s1b, m1 = step(
+            s1, batch.feats, batch.feat_masks, batch.captions, ones, None,
+            batch.video_idx, step_rng, 0.0,
+        )
+
+        # 8-way DP mesh: replicated params, sharded batch.
+        mesh = make_mesh({"data": -1, "model": 1})
+        s8 = create_train_state(rng, model, tx, batch._asdict(), mesh=mesh)
+        sh = batch_sharding(mesh)
+        feats = shard_batch(batch.feats, mesh)
+        fmasks = shard_batch(batch.feat_masks, mesh)
+        caps = jax.device_put(batch.captions, sh)
+        w = jax.device_put(np.ones_like(batch.weights), sh)
+        vidx = jax.device_put(batch.video_idx, sh)
+        s8b, m8 = step(
+            s8, feats, fmasks, caps, w, None, vidx, step_rng, 0.0,
+        )
+
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(m8["loss"]), rtol=1e-5
+        )
+        _params_allclose(s1b.params, s8b.params)
+
+    def test_tp_sharding_matches_replicated(self):
+        cfg = get_preset("synthetic_smoke")
+        ds, model, tx, batch = _setup(cfg, vocab_multiple=4)
+        rng = jax.random.PRNGKey(0)
+        step_rng = jax.random.PRNGKey(1)
+        s1 = create_train_state(rng, model, tx, batch._asdict())
+        step = make_xe_train_step(model)
+        ones = jnp.ones_like(jnp.asarray(batch.weights))
+        s1b, m1 = step(
+            s1, batch.feats, batch.feat_masks, batch.captions, ones, None,
+            batch.video_idx, step_rng, 0.0,
+        )
+
+        mesh = make_mesh({"data": 2, "model": 4})
+        stp = create_train_state(rng, model, tx, batch._asdict(), mesh=mesh)
+        # vocab-sized params actually sharded over the model axis
+        emb_shard = stp.params["params"]["word_embed"].sharding
+        assert emb_shard.spec == P("model", None)
+        sh = batch_sharding(mesh)
+        stpb, mtp = step(
+            stp,
+            shard_batch(batch.feats, mesh),
+            shard_batch(batch.feat_masks, mesh),
+            jax.device_put(batch.captions, sh),
+            jax.device_put(np.ones_like(batch.weights), sh),
+            None,
+            jax.device_put(batch.video_idx, sh),
+            step_rng,
+            0.0,
+        )
+        np.testing.assert_allclose(
+            float(m1["loss"]), float(mtp["loss"]), rtol=1e-5
+        )
+        _params_allclose(s1b.params, stpb.params)
+
+
+class TestTrainerOnMesh:
+    def test_fit_epoch_on_mesh(self, tmp_path):
+        ds, _ = make_synthetic_dataset(num_videos=16, max_frames=6, seed=9)
+        cfg = get_preset("synthetic_smoke")
+        cfg.data.batch_size = 8
+        cfg.data.seq_per_img = 2
+        cfg.train.checkpoint_dir = str(tmp_path)
+        cfg.train.max_epochs = 2
+        cfg.train.max_patience = 0
+        cfg.eval.metrics = ["CIDEr"]
+        cfg.eval.max_decode_len = 11
+        t = Trainer(cfg, train_ds=ds, val_ds=ds, workdir=str(tmp_path / "w"))
+        assert t.mesh is not None and t.mesh.shape == {"data": 8, "model": 1}
+        hist = t.fit()
+        assert np.isfinite(hist["1"]["train_loss"])
+        assert "val" in hist["1"]
+
+    def test_cst_step_on_mesh(self, tmp_path):
+        ds, _ = make_synthetic_dataset(num_videos=16, max_frames=6, seed=9)
+        cfg = get_preset("synthetic_smoke")
+        cfg.data.batch_size = 8
+        cfg.data.seq_per_img = 2
+        cfg.data.max_seq_len = 11
+        cfg.train.checkpoint_dir = str(tmp_path)
+        cfg.train.train_mode = "cst"
+        cfg.train.cst_baseline = "greedy"
+        cfg.train.cst_num_samples = 2
+        cfg.train.max_epochs = 1
+        cfg.train.max_patience = 0
+        cfg.eval.metrics = ["CIDEr"]
+        cfg.eval.max_decode_len = 11
+        t = Trainer(cfg, train_ds=ds, val_ds=None,
+                    workdir=str(tmp_path / "w2"))
+        hist = t.fit()
+        assert np.isfinite(hist["0"]["train_loss"])
+        assert np.isfinite(hist["0"]["reward"])
