@@ -41,6 +41,8 @@ def build_workload():
 
     cfg = get_preset("msrvtt_resnet_c3d_xe")
     cfg.model.vocab_size = 10496  # MSR-VTT-scale vocab, multiple of 256
+    if os.environ.get("BENCH_PALLAS", "1") == "1":
+        cfg.model.use_pallas_lstm = True
     B, S, F, T = (
         cfg.data.batch_size,
         cfg.data.seq_per_img,

@@ -97,6 +97,7 @@ class CaptionModel(nn.Module):
     category_embed_size: int = 64
     compute_dtype: str = "bfloat16"
     param_dtype: str = "float32"
+    use_pallas: bool = False  # fused LSTM recurrence kernel fast path
 
     # ---------------------------------------------------------------- setup
     def setup(self):
@@ -293,6 +294,14 @@ class CaptionModel(nn.Module):
         # is the single batched one over (B, T, H) below.
         use_ss = not (isinstance(ss_prob, float) and ss_prob == 0.0)
 
+        if self.use_pallas and not use_ss and self.fusion != "attention":
+            # Fused fast path: static per-video context, so every step's
+            # input is known up front — input GEMMs batch over (B, T) and
+            # the recurrence runs in the Pallas kernel (ops/pallas_lstm.py).
+            h_seq = self._fused_forward(cache, input_ids)
+            h_seq = self._output_dropout(h_seq, deterministic)
+            return self._logits(h_seq)
+
         def step(carry, tok_t):
             state, prev_sample, key = carry
             if use_ss:
@@ -316,12 +325,58 @@ class CaptionModel(nn.Module):
             jnp.swapaxes(input_ids, 0, 1),
         )
         h_seq = jnp.swapaxes(h_seq, 0, 1)  # (B, T, H)
-        if not deterministic and self.drop_prob > 0.0:
-            drop_rng = self.make_rng("dropout")
-            keep = 1.0 - self.drop_prob
-            mask = jax.random.bernoulli(drop_rng, keep, h_seq.shape)
-            h_seq = jnp.where(mask, h_seq / keep, 0.0).astype(h_seq.dtype)
+        h_seq = self._output_dropout(h_seq, deterministic)
         return self._logits(h_seq)
+
+    def _output_dropout(self, h_seq: jax.Array, deterministic: bool) -> jax.Array:
+        if deterministic or self.drop_prob <= 0.0:
+            return h_seq
+        drop_rng = self.make_rng("dropout")
+        keep = 1.0 - self.drop_prob
+        mask = jax.random.bernoulli(drop_rng, keep, h_seq.shape)
+        return jnp.where(mask, h_seq / keep, 0.0).astype(h_seq.dtype)
+
+    def _fused_forward(
+        self, cache: DecodeCache, input_ids: jax.Array
+    ) -> jax.Array:
+        """Batched-input-GEMM + Pallas recurrence path (meanpool fusion,
+        no scheduled sampling).  Numerics per ``ops/rnn.py``: bf16 matmuls
+        with float32 gate accumulation and float32 cell state."""
+        from cst_captioning_tpu.ops.pallas_lstm import lstm_recurrence
+
+        cdt = jnp.dtype(self.compute_dtype)
+        B, T = input_ids.shape
+        emb = self.word_embed.astype(cdt)[input_ids]           # (B, T, E)
+        E = emb.shape[-1]
+        # Static per-video rows (context + category) hit their kernel rows
+        # ONCE per batch row, not once per timestep: gx = emb @ Wx_emb +
+        # (static @ Wx_static + b) broadcast over T.
+        static = jnp.concatenate(
+            [cache.ctx_static.astype(cdt), cache.cat_emb], axis=-1
+        )  # (B, E [+C])
+        x = emb
+        for layer in range(self.num_layers):
+            w, b = self.lstm[layer]
+            dx = x.shape[-1]
+            wx = w[:dx].astype(cdt)
+            gx = jnp.einsum(
+                "btd,dg->btg", x.astype(cdt), wx,
+                preferred_element_type=jnp.float32,
+            )
+            if layer == 0:
+                d_in = dx + static.shape[-1]
+                w_static = w[dx:d_in].astype(cdt)
+                gstatic = jnp.einsum(
+                    "bd,dg->bg", static, w_static,
+                    preferred_element_type=jnp.float32,
+                )
+                gx = gx + gstatic[:, None, :]
+            else:
+                d_in = dx
+            gx = gx + b.astype(jnp.float32)
+            wh = w[d_in:].astype(cdt)
+            x = lstm_recurrence(gx, wh, True)
+        return x
 
     # --------------------------------------------------------------- decode
     def init_decode(
@@ -431,4 +486,5 @@ def model_from_config(cfg) -> CaptionModel:
         category_embed_size=m.category_embed_size,
         compute_dtype=m.compute_dtype,
         param_dtype=m.param_dtype,
+        use_pallas=m.use_pallas_lstm,
     )

@@ -1,0 +1,306 @@
+"""Fused LSTM recurrence as a Pallas TPU kernel.
+
+The teacher-forced decoder (hot loop #1, SURVEY.md §3) spends its time in
+T sequential LSTM steps.  The classic split (cuDNN's LSTM trick, rebuilt
+TPU-style) is:
+
+* **input GEMMs** ``x_t @ W_x`` have no recurrence — they run as ONE large
+  batched XLA matmul over the whole (B, T) grid, fully MXU-efficient;
+* the **recurrent part** — ``gates = gx_t + h @ W_h``; gate nonlinearities;
+  state update — is fused here into one Pallas kernel that keeps ``W_h``
+  and the (h, c) state pinned in VMEM across a time-chunked grid, instead
+  of XLA's scan which round-trips state through HBM every step.
+
+Grid: ``(batch_tiles, time_chunks)``, TIME-MAJOR blocks ``(tc, bt, ...)``
+so the per-step dynamic time index hits the untiled leading dim (Mosaic
+tiles the last two dims).  TPU grid execution is sequential with the last
+dimension innermost, so for a fixed batch tile the kernel sees time chunks
+in order; (h, c) live in scratch VMEM that persists across chunks and
+resets at chunk 0.  Pallas pipelines the gx block fetch (HBM->VMEM) of
+chunk t+1 against compute of chunk t automatically.
+
+The decoder always starts from zero state, and this module bakes that in
+(no h0/c0 in the public API — a nonzero-state variant must extend the
+kernel AND the backward together).
+
+Autodiff: ``lstm_recurrence`` carries a ``jax.custom_vjp``: the forward
+saves (h_seq, float32 c_seq) residuals — the cell output exists ONLY under
+the VJP; plain no-grad forwards skip writing it — and the backward is an
+analytic reverse scan over those residuals (gate pre-activations
+recomputed with one matmul per step; ``dwh`` reduced with one batched
+contraction).  A hand-written backward kernel is a future optimization.
+
+Numerics match ``ops/rnn.py::lstm_step``: gates accumulate in float32, the
+cell state stays float32, gate order i|f|g|o.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _gate_update(gates: jax.Array, c: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(B, 4H) float32 pre-activations + (B, H) float32 cell -> (h, c)."""
+    H = c.shape[-1]
+    i = jax.nn.sigmoid(gates[:, :H])
+    f = jax.nn.sigmoid(gates[:, H : 2 * H])
+    g = jnp.tanh(gates[:, 2 * H : 3 * H])
+    o = jax.nn.sigmoid(gates[:, 3 * H :])
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+# ----------------------------------------------------------- reference path
+
+def lstm_recurrence_scan(gx: jax.Array, wh: jax.Array, with_cell: bool = False):
+    """Reference recurrence from zero state: ``gx`` (B, T, 4H) float32
+    pre-computed input gates (already + bias), ``wh`` (H, 4H).  Returns
+    h_seq (B, T, H) (float32 math, cast at the end); with ``with_cell``
+    also the float32 cell sequence (residual for the backward)."""
+    B = gx.shape[0]
+    H = wh.shape[0]
+
+    def step(carry, g_t):
+        h, c = carry
+        gates = g_t + (h.astype(wh.dtype) @ wh).astype(jnp.float32)
+        h_new, c_new = _gate_update(gates, c)
+        return (h_new, c_new), (h_new, c_new)
+
+    zeros = jnp.zeros((B, H), jnp.float32)
+    (_, _), (h_seq, c_seq) = jax.lax.scan(
+        step, (zeros, zeros), jnp.swapaxes(gx, 0, 1).astype(jnp.float32)
+    )
+    h_seq = jnp.swapaxes(h_seq, 0, 1)
+    if with_cell:
+        return h_seq, jnp.swapaxes(c_seq, 0, 1)
+    return h_seq
+
+
+# -------------------------------------------------------------- pallas path
+
+def _make_kernel(with_cell: bool):
+    def kernel(gx_ref, wh_ref, *refs):
+        """One (batch_tile, time_chunk) grid step.
+
+        gx_ref   (Tc, Bt, 4H) VMEM — input gates for this chunk
+        wh_ref   (H, 4H)      VMEM — recurrent kernel (same block each step)
+        out_ref  (Tc, Bt, H)  VMEM — hidden outputs
+        cell_ref (Tc, Bt, H)  VMEM — f32 cell residual (with_cell only)
+        h_scr/c_scr (Bt, H) f32 VMEM scratch — persist across time chunks
+        """
+        if with_cell:
+            out_ref, cell_ref, h_scr, c_scr = refs
+        else:
+            out_ref, h_scr, c_scr = refs
+        t_chunk = pl.program_id(1)
+
+        @pl.when(t_chunk == 0)
+        def _():
+            h_scr[:] = jnp.zeros_like(h_scr)
+            c_scr[:] = jnp.zeros_like(c_scr)
+
+        Tc = gx_ref.shape[0]
+        wh = wh_ref[:]
+
+        def body(tt, _):
+            h = h_scr[:]
+            rec = jax.lax.dot_general(
+                h.astype(wh.dtype),
+                wh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            gates = gx_ref[tt].astype(jnp.float32) + rec
+            h_new, c_new = _gate_update(gates, c_scr[:])
+            h_scr[:] = h_new
+            c_scr[:] = c_new
+            out_ref[tt] = h_new.astype(out_ref.dtype)
+            if with_cell:
+                cell_ref[tt] = c_new
+            return 0
+
+        jax.lax.fori_loop(0, Tc, body, 0)
+
+    return kernel
+
+
+def _pick_tiles(B: int, T: int, G: int, itemsize: int) -> Tuple[int, int]:
+    """Tiling for time-major gx (T, B, G) with blocks (tc, bt, G).
+
+    Mosaic tiles the last two block dims, so ``bt`` must be a multiple of
+    8 or the whole B (G is the full gate width, a multiple of 128 for
+    H >= 32); the leading time dim ``tc`` is unconstrained — any divisor
+    of T.  Sizes are capped so the double-buffered gx block stays a few
+    MB of VMEM.
+    """
+    budget = 4 * 1024 * 1024
+    bts = [b for b in range(8, B + 1, 8) if B % b == 0]
+    bt = max(bts) if bts else B
+    # Cap bt, then pick the time chunk to fill the budget.
+    while bt > 8 and bt * G * itemsize * 4 > budget:
+        half = bt // 2
+        bt = half - (half % 8) or 8
+        while B % bt and bt > 8:
+            bt -= 8
+        if B % bt:
+            bt = B
+            break
+    tc_max = max(1, budget // max(1, bt * G * itemsize))
+    tc = min(T, tc_max, 8)
+    while T % tc:
+        tc -= 1
+    return bt, max(tc, 1)
+
+
+def lstm_recurrence_pallas(
+    gx: jax.Array,
+    wh: jax.Array,
+    *,
+    with_cell: bool = False,
+    interpret: bool = False,
+):
+    """Pallas forward from zero state.  Returns h_seq (B, T, H), plus the
+    float32 cell sequence when ``with_cell`` (backward residual)."""
+    B, T, G = gx.shape
+    H = wh.shape[0]
+    bt, tc = _pick_tiles(B, T, G, gx.dtype.itemsize)
+    grid = (B // bt, T // tc)
+    gx_tm = jnp.swapaxes(gx, 0, 1)  # (T, B, 4H) time-major
+    block = lambda width: pl.BlockSpec(  # noqa: E731
+        (tc, bt, width), lambda b, t: (t, b, 0), memory_space=pltpu.VMEM
+    )
+    out_specs = [block(H)]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H), wh.dtype)]
+    if with_cell:
+        out_specs.append(block(H))
+        out_shape.append(jax.ShapeDtypeStruct((T, B, H), jnp.float32))
+    outs = pl.pallas_call(
+        _make_kernel(with_cell),
+        grid=grid,
+        in_specs=[
+            block(G),
+            pl.BlockSpec((H, G), lambda b, t: (0, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((bt, H), jnp.float32),
+            pltpu.VMEM((bt, H), jnp.float32),
+        ],
+        interpret=interpret,
+    )(gx_tm, wh)
+    if with_cell:
+        return jnp.swapaxes(outs[0], 0, 1), jnp.swapaxes(outs[1], 0, 1)
+    return jnp.swapaxes(outs[0], 0, 1)
+
+
+# ----------------------------------------------------- analytic backward
+
+def lstm_recurrence_bwd_scan(gx, wh, h_seq, c_seq, dh_out):
+    """Analytic reverse pass over saved residuals — no forward recompute.
+
+    Per step t (descending): recompute gate pre-activations from
+    ``gx[t] + h_{t-1} @ wh`` (one matmul), derive gate activations, then
+    standard LSTM cotangents.  Returns (dgx, dwh).
+    """
+    B, T, G = gx.shape
+    H = wh.shape[0]
+    whf = wh.astype(jnp.float32)
+
+    h_prev = jnp.concatenate(
+        [jnp.zeros((B, 1, H), jnp.float32), h_seq[:, :-1].astype(jnp.float32)],
+        axis=1,
+    )
+    c_prev = jnp.concatenate(
+        [jnp.zeros((B, 1, H), jnp.float32), c_seq[:, :-1]], axis=1
+    )
+
+    def step(carry, xs):
+        dh_next, dc_next = carry
+        gx_t, hp, cp, c_t, dout_t = xs
+        gates = gx_t + hp @ whf
+        i = jax.nn.sigmoid(gates[:, :H])
+        f = jax.nn.sigmoid(gates[:, H : 2 * H])
+        g = jnp.tanh(gates[:, 2 * H : 3 * H])
+        o = jax.nn.sigmoid(gates[:, 3 * H :])
+        tc_t = jnp.tanh(c_t)
+        dh = dout_t + dh_next
+        do = dh * tc_t * o * (1 - o)
+        dc = dc_next + dh * o * (1 - tc_t * tc_t)
+        di = dc * g * i * (1 - i)
+        df = dc * cp * f * (1 - f)
+        dg = dc * i * (1 - g * g)
+        dgates = jnp.concatenate([di, df, dg, do], axis=-1)
+        dh_prev = dgates @ whf.T
+        dc_prev = dc * f
+        return (dh_prev, dc_prev), (dgates, hp)
+
+    xs = (
+        jnp.swapaxes(gx, 0, 1).astype(jnp.float32),
+        jnp.swapaxes(h_prev, 0, 1),
+        jnp.swapaxes(c_prev, 0, 1),
+        jnp.swapaxes(c_seq, 0, 1),
+        jnp.swapaxes(dh_out, 0, 1).astype(jnp.float32),
+    )
+    (_, _), (dgates_seq, hp_seq) = jax.lax.scan(
+        step,
+        (jnp.zeros((B, H), jnp.float32), jnp.zeros((B, H), jnp.float32)),
+        xs,
+        reverse=True,
+    )
+    dgx = jnp.swapaxes(dgates_seq, 0, 1).astype(gx.dtype)
+    # dwh = sum_t h_{t-1}^T dgates_t — one batched MXU contraction.
+    dwh = jnp.einsum(
+        "tbh,tbg->hg", hp_seq, dgates_seq, preferred_element_type=jnp.float32
+    ).astype(wh.dtype)
+    return dgx, dwh
+
+
+# ---------------------------------------------------------- public wrapper
+
+def _use_kernel(gx, use_pallas: bool) -> bool:
+    # Tiny batches (param init traces with B=1) take the scan path — the
+    # kernel's scratch tiling wants a sublane-aligned batch tile.
+    return use_pallas and gx.shape[0] >= 8
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def lstm_recurrence(gx, wh, use_pallas: bool = False):
+    """Recurrent LSTM over pre-computed input gates, from zero state.
+
+    gx (B, T, 4H) float32 = x @ W_x + b;  wh (H, 4H).
+    Returns h_seq (B, T, H) in wh.dtype.
+    """
+    # Primal-only path: no residuals, no cell output written.
+    if _use_kernel(gx, use_pallas):
+        interpret = jax.default_backend() == "cpu"
+        return lstm_recurrence_pallas(gx, wh, interpret=interpret)
+    return lstm_recurrence_scan(gx, wh).astype(wh.dtype)
+
+
+def _fwd(gx, wh, use_pallas):
+    if _use_kernel(gx, use_pallas):
+        interpret = jax.default_backend() == "cpu"
+        h_seq, c_seq = lstm_recurrence_pallas(
+            gx, wh, with_cell=True, interpret=interpret
+        )
+    else:
+        h_seq, c_seq = lstm_recurrence_scan(gx, wh, with_cell=True)
+        h_seq = h_seq.astype(wh.dtype)
+    return h_seq, (gx, wh, h_seq, c_seq)
+
+
+def _bwd(use_pallas, res, g):
+    gx, wh, h_seq, c_seq = res
+    dgx, dwh = lstm_recurrence_bwd_scan(gx, wh, h_seq, c_seq, g)
+    return dgx, dwh
+
+
+lstm_recurrence.defvjp(_fwd, _bwd)
