@@ -345,9 +345,7 @@ class CaptionModel(nn.Module):
         from cst_captioning_tpu.ops.pallas_lstm import lstm_recurrence
 
         cdt = jnp.dtype(self.compute_dtype)
-        B, T = input_ids.shape
         emb = self.word_embed.astype(cdt)[input_ids]           # (B, T, E)
-        E = emb.shape[-1]
         # Static per-video rows (context + category) hit their kernel rows
         # ONCE per batch row, not once per timestep: gx = emb @ Wx_emb +
         # (static @ Wx_static + b) broadcast over T.

@@ -1,0 +1,186 @@
+"""Native (C++) components and their ctypes bindings.
+
+``ciderd.cpp`` is the CST reward scorer's fast path; ``build_ciderd()``
+compiles it on first use with g++ (no pybind11 in this environment — the
+binding is a plain C ABI via ctypes) and caches the .so next to the
+source.  ``NativeCiderD`` mirrors the scoring core of
+``training/rewards.CiderDRewarder`` exactly; parity is tested in
+``tests/test_native_ciderd.py``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import math
+import os
+import subprocess
+import threading
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("cst_captioning_tpu.native")
+
+_SRC = os.path.join(os.path.dirname(__file__), "ciderd.cpp")
+_LIB = os.path.join(os.path.dirname(__file__), "_ciderd.so")
+_BUILD_LOCK = threading.Lock()
+_LIB_HANDLE: Optional[ctypes.CDLL] = None
+
+MAX_TOKEN_ID = 1 << 15  # packing bound in ciderd.cpp
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def build_ciderd(force: bool = False) -> str:
+    """Compile ciderd.cpp -> _ciderd.so (cached; rebuilt when stale)."""
+    with _BUILD_LOCK:
+        if (
+            not force
+            and os.path.exists(_LIB)
+            and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
+        ):
+            return _LIB
+        cmd = [
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            _SRC, "-o", _LIB,
+        ]
+        try:
+            subprocess.run(
+                cmd, check=True, capture_output=True, text=True, timeout=120
+            )
+        except (OSError, subprocess.SubprocessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            raise NativeUnavailable(f"g++ build failed: {detail}") from e
+        return _LIB
+
+
+def _load() -> ctypes.CDLL:
+    global _LIB_HANDLE
+    if _LIB_HANDLE is not None:
+        return _LIB_HANDLE
+    lib = ctypes.CDLL(build_ciderd())
+    lib.ciderd_new.restype = ctypes.c_void_p
+    lib.ciderd_free.argtypes = [ctypes.c_void_p]
+    lib.ciderd_add_video.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+    ]
+    lib.ciderd_finalize.argtypes = [ctypes.c_void_p]
+    lib.ciderd_set_df.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int,
+    ]
+    lib.ciderd_finalize_with_df.argtypes = [ctypes.c_void_p, ctypes.c_double]
+    lib.ciderd_num_videos.argtypes = [ctypes.c_void_p]
+    lib.ciderd_num_videos.restype = ctypes.c_int
+    lib.ciderd_score.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(ctypes.c_int),
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+    ]
+    _LIB_HANDLE = lib
+    return lib
+
+
+def _int_ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int))
+
+
+class NativeCiderD:
+    """C++ CIDEr-D scorer over token-id sequences.
+
+    ``refs_per_video``: list (dataset order) of lists of id sequences
+    (word ids only — no BOS/EOS/PAD).  ``df`` optional {ngram tuple: raw
+    df} with ``log_ref_len`` for idf-table mode; corpus mode otherwise.
+    """
+
+    def __init__(
+        self,
+        refs_per_video: List[List[Sequence[int]]],
+        df=None,
+        log_ref_len: Optional[float] = None,
+        vocab_size: Optional[int] = None,
+    ):
+        # The packing bound must hold for anything a CANDIDATE can contain
+        # (sampled rollouts range over the whole vocab), not just the refs.
+        if vocab_size is not None and vocab_size > MAX_TOKEN_ID:
+            raise NativeUnavailable(
+                f"vocab_size {vocab_size} exceeds the native packing bound "
+                f"({MAX_TOKEN_ID})"
+            )
+        lib = _load()
+        self._lib = lib
+        self._handle = ctypes.c_void_p(lib.ciderd_new())
+        for refs in refs_per_video:
+            for r in refs:
+                if any(t >= MAX_TOKEN_ID for t in r):
+                    raise NativeUnavailable(
+                        f"token id >= {MAX_TOKEN_ID} exceeds the native "
+                        "packing bound"
+                    )
+            flat = np.asarray(
+                [t for r in refs for t in r], dtype=np.int32
+            )
+            lens = np.asarray([len(r) for r in refs], dtype=np.int32)
+            if flat.size == 0:
+                flat = np.zeros(1, np.int32)  # valid pointer, lens all 0
+            lib.ciderd_add_video(
+                self._handle, _int_ptr(flat), _int_ptr(lens), len(refs)
+            )
+        if df is None:
+            lib.ciderd_finalize(self._handle)
+        else:
+            ngrams = list(df.items())
+            flat = np.asarray(
+                [t for ng, _ in ngrams for t in ng], dtype=np.int32
+            )
+            lens = np.asarray([len(ng) for ng, _ in ngrams], dtype=np.int32)
+            vals = np.asarray([v for _, v in ngrams], dtype=np.float32)
+            if flat.size == 0:
+                flat = np.zeros(1, np.int32)
+            lib.ciderd_set_df(
+                self._handle,
+                _int_ptr(flat),
+                _int_ptr(lens),
+                vals.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                len(ngrams),
+            )
+            if log_ref_len is None:
+                log_ref_len = math.log(max(len(refs_per_video), 2))
+            lib.ciderd_finalize_with_df(
+                self._handle, ctypes.c_double(log_ref_len)
+            )
+
+    def __del__(self):
+        try:
+            self._lib.ciderd_free(self._handle)
+        except Exception:
+            pass
+
+    def score_ids(
+        self, video_idx: np.ndarray, token_ids: np.ndarray
+    ) -> np.ndarray:
+        vidx = np.ascontiguousarray(video_idx, dtype=np.int32)
+        toks = np.ascontiguousarray(token_ids, dtype=np.int32)
+        B, L = toks.shape
+        out = np.zeros((B,), np.float32)
+        self._lib.ciderd_score(
+            self._handle,
+            _int_ptr(vidx),
+            _int_ptr(toks),
+            B,
+            L,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        return out

@@ -280,16 +280,21 @@ def lstm_recurrence(gx, wh, use_pallas: bool = False):
     """
     # Primal-only path: no residuals, no cell output written.
     if _use_kernel(gx, use_pallas):
-        interpret = jax.default_backend() == "cpu"
-        return lstm_recurrence_pallas(gx, wh, interpret=interpret)
+        return lstm_recurrence_pallas(gx, wh, interpret=_interpret())
     return lstm_recurrence_scan(gx, wh).astype(wh.dtype)
+
+
+def _interpret() -> bool:
+    # Mosaic lowering exists only on TPU backends (the axon remote-TPU
+    # platform also reports "tpu"); anything else (cpu tests, gpu) runs
+    # the kernel in interpret mode rather than failing to lower.
+    return jax.default_backend() != "tpu"
 
 
 def _fwd(gx, wh, use_pallas):
     if _use_kernel(gx, use_pallas):
-        interpret = jax.default_backend() == "cpu"
         h_seq, c_seq = lstm_recurrence_pallas(
-            gx, wh, with_cell=True, interpret=interpret
+            gx, wh, with_cell=True, interpret=_interpret()
         )
     else:
         h_seq, c_seq = lstm_recurrence_scan(gx, wh, with_cell=True)

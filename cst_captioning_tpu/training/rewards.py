@@ -62,12 +62,18 @@ class CiderDRewarder:
         dataset: CaptionDataset,
         df_mode: str = "corpus",
         use_d: bool = True,
+        backend: str = "auto",
     ):
         """``df_mode="corpus"``: document frequencies over this dataset's
         reference sets (the reference's train-corpus idf option);
         otherwise a path to a saved idf table (reference pickle parity) —
         in that case the table's *string* n-grams are re-encoded through
         the vocab so they match id n-grams.
+
+        ``backend``: "auto" builds the C++ scorer (``native/ciderd.cpp``)
+        and silently falls back to Python when g++/packing bounds don't
+        allow it; "native" raises instead of falling back; "python" skips
+        the native path.
         """
         self.vocab = dataset.vocab
         self.use_d = use_d
@@ -78,16 +84,18 @@ class CiderDRewarder:
 
         # Vocab-encode every video's references (tokenize like the metric
         # pipeline so idf tables and eval tokenization agree).
+        self._encoded_refs: List[List[List[int]]] = []
         self._cooked_refs = []
         for i in range(len(dataset)):
             refs = dataset.references(i)
-            self._cooked_refs.append(
-                [precook(encode_tokens(ptb_tokenize(r))) for r in refs]
-            )
+            encoded = [encode_tokens(ptb_tokenize(r)) for r in refs]
+            self._encoded_refs.append(encoded)
+            self._cooked_refs.append([precook(e) for e in encoded])
 
         if df_mode == "corpus":
             self.doc_freq = compute_doc_freq(self._cooked_refs)
             self.log_ref_len = math.log(float(max(len(dataset), 2)))
+            self._df_external = None
         else:
             base = _CiderBase(df_mode=df_mode)
             # Re-key string n-grams to id n-grams.
@@ -97,11 +105,45 @@ class CiderDRewarder:
                 # Collisions (via UNK) keep the max df — conservative idf.
                 self.doc_freq[key] = max(df, self.doc_freq.get(key, 0.0))
             self.log_ref_len = base._log_ref_len
+            self._df_external = self.doc_freq
 
-        self._ref_vecs = [
-            cook_refs_vec(refs, self.doc_freq, self.log_ref_len)
-            for refs in self._cooked_refs
-        ]
+        self._native = None
+        self.backend = "python"
+        if backend in ("auto", "native"):
+            try:
+                if not use_d:
+                    from cst_captioning_tpu.native import NativeUnavailable
+
+                    raise NativeUnavailable(
+                        "plain CIDEr (use_d=False) has no native scorer"
+                    )
+                from cst_captioning_tpu.native import NativeCiderD
+
+                self._native = NativeCiderD(
+                    self._encoded_refs,
+                    df=self._df_external,
+                    log_ref_len=self.log_ref_len,
+                    vocab_size=len(self.vocab),
+                )
+                self.backend = "native"
+            except Exception as e:
+                if backend == "native":
+                    raise
+                import logging
+
+                logging.getLogger("cst_captioning_tpu.rewards").info(
+                    "native CiderD unavailable (%s); using python scorer", e
+                )
+        # Python tf-idf ref vectors: only cooked when actually scoring in
+        # Python (the native finalize performs the same cooking in C++).
+        self._ref_vecs = (
+            None
+            if self._native is not None
+            else [
+                cook_refs_vec(refs, self.doc_freq, self.log_ref_len)
+                for refs in self._cooked_refs
+            ]
+        )
 
     def score_ids(
         self, video_idx: np.ndarray, token_ids: np.ndarray
@@ -110,6 +152,8 @@ class CiderDRewarder:
         CIDEr-D scores (x10 scale, like the reference scorer)."""
         video_idx = np.asarray(video_idx)
         token_ids = np.asarray(token_ids)
+        if self._native is not None:
+            return self._native.score_ids(video_idx, token_ids)
         out = np.zeros((token_ids.shape[0],), np.float32)
         for b in range(token_ids.shape[0]):
             cand = precook(ids_until_end(token_ids[b]))
