@@ -1,0 +1,140 @@
+"""Staged experiment pipeline — the reference Makefile's experiment
+targets (SURVEY.md §2 L6: "targets chaining the paper's regimes: XE;
+CST_GT_None (=WXE); CST_MS_Greedy; CST_MS_SCB; per-dataset/feature-set
+variables"), rebuilt as a single driver that chains the stages with
+warm-start plumbing and ends with a beam-search evaluation.
+
+  python -m cst_captioning_tpu.cli.pipeline --preset msrvtt_resnet_c3d_xe \\
+      [--stages xe,wxe,cst] [--eval-split test] [--<section>.<field> ...]
+
+Each stage trains to keep-best on val CIDEr, and the next stage
+warm-starts from that checkpoint — the paper's XE -> WXE -> CST staging
+(SURVEY.md §7 hard part #4: CST is seed/LR sensitive; exact staging is the
+mitigation).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+from typing import Dict, List, Optional
+
+from cst_captioning_tpu.config import Config, parse_cli
+from cst_captioning_tpu.data.build import build_dataset
+
+log = logging.getLogger("cst_captioning_tpu.pipeline")
+
+# Stage recipes: overrides applied on top of the base config.  LRs follow
+# the reference's fine-tune convention (lower LR after warm start).
+STAGE_RECIPES: Dict[str, Dict] = {
+    "xe": {"train.train_mode": "xe"},
+    "wxe": {"train.train_mode": "wxe", "train.learning_rate": 1e-4},
+    "cst": {
+        "train.train_mode": "cst",
+        "train.cst_baseline": "scb",
+        "train.learning_rate": 1e-4,
+    },
+    "cst_greedy": {
+        "train.train_mode": "cst",
+        "train.cst_baseline": "greedy",
+        "train.learning_rate": 1e-4,
+    },
+}
+
+
+def run_pipeline(
+    base_cfg: Config,
+    stages: List[str],
+    eval_split: Optional[str] = "test",
+) -> Dict[str, dict]:
+    """Run the staged pipeline; returns {stage: history} + final scores."""
+    from cst_captioning_tpu.training.trainer import Trainer
+
+    train_ds, vocab = build_dataset(base_cfg, "train")
+    try:
+        val_ds, _ = build_dataset(base_cfg, "val", vocab=vocab)
+    except (KeyError, FileNotFoundError, ValueError):
+        log.warning("no val split — stages keep their last checkpoint")
+        val_ds = None
+
+    results: Dict[str, dict] = {}
+    prev_best = base_cfg.train.start_from
+    last_cfg = base_cfg
+    for stage in stages:
+        if stage not in STAGE_RECIPES:
+            raise KeyError(
+                f"unknown stage {stage!r}; have {sorted(STAGE_RECIPES)}"
+            )
+        cfg = base_cfg.replace(**STAGE_RECIPES[stage])
+        cfg.name = f"{base_cfg.name}_{stage}"
+        cfg.train.start_from = prev_best
+        trainer = Trainer(cfg, train_ds=train_ds, val_ds=val_ds)
+        log.info(
+            "=== stage %s (mode=%s, warm_start=%s) ===",
+            stage, cfg.train.train_mode, prev_best or "none",
+        )
+        results[stage] = trainer.fit()
+        best = os.path.join(trainer.workdir, "best")
+        last = os.path.join(trainer.workdir, "last")
+        prev_best = best if os.path.exists(best) else last
+        last_cfg = cfg
+        log.info("stage %s done; checkpoint %s", stage, prev_best)
+
+    if eval_split:
+        import jax
+
+        from cst_captioning_tpu.evaluation import evaluate_dataset
+        from cst_captioning_tpu.models.captioner import model_from_config
+        from cst_captioning_tpu.training.checkpoint import restore_params
+
+        eval_ds, _ = build_dataset(last_cfg, eval_split, vocab=vocab)
+        model = model_from_config(last_cfg)
+        feats = {
+            m: jax.numpy.zeros((1, last_cfg.data.max_frames, dim))
+            for m, dim in train_ds.feature_dims.items()
+        }
+        masks = {m: jax.numpy.ones((1, last_cfg.data.max_frames)) for m in feats}
+        ids = jax.numpy.ones((1, 2), jax.numpy.int32)
+        cat = (
+            jax.numpy.zeros((1,), jax.numpy.int32)
+            if last_cfg.model.use_category
+            else None
+        )
+        template = model.init(
+            jax.random.PRNGKey(0), feats, masks, ids, category=cat
+        )
+        params = restore_params(prev_best, template)
+        out_dir = os.path.join(
+            last_cfg.train.checkpoint_dir, base_cfg.name, "eval"
+        )
+        scores, _ = evaluate_dataset(
+            model, params, eval_ds, last_cfg, out_dir=out_dir
+        )
+        results["eval"] = {"split": eval_split, "scores": scores,
+                           "out_dir": out_dir}
+        log.info("final eval (%s): %s", eval_split, scores)
+    return results
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--stages", default="xe,wxe,cst")
+    parser.add_argument("--eval-split", default="test")
+    known, rest = parser.parse_known_args(argv)
+    cfg = parse_cli(rest)
+    stages = [s.strip() for s in known.stages.split(",") if s.strip()]
+    results = run_pipeline(cfg, stages, eval_split=known.eval_split or None)
+    out = os.path.join(cfg.train.checkpoint_dir, cfg.name, "pipeline.json")
+    os.makedirs(os.path.dirname(out), exist_ok=True)
+    with open(out, "w") as f:
+        json.dump(results, f, indent=2, default=str)
+    print(json.dumps(results.get("eval", {}), default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -98,6 +98,7 @@ class CaptionModel(nn.Module):
     compute_dtype: str = "bfloat16"
     param_dtype: str = "float32"
     use_pallas: bool = False  # fused LSTM recurrence kernel fast path
+    remat: bool = False       # rematerialize the decoder scan body
 
     # ---------------------------------------------------------------- setup
     def setup(self):
@@ -318,6 +319,12 @@ class CaptionModel(nn.Module):
                 prev_sample = sampled.astype(jnp.int32)
             return (state, prev_sample, key), h_top
 
+        if self.remat:
+            # Trade FLOPs for HBM: recompute the step in the backward pass
+            # instead of saving per-step intermediates (TrainConfig.remat).
+            # prevent_cse=False: scan already blocks cross-iteration CSE,
+            # so the default optimization barriers would only hurt fusion.
+            step = jax.checkpoint(step, prevent_cse=False)
         # At t=0 the input is BOS — never replaced (prev_sample init = column 0).
         (_, _, _), h_seq = jax.lax.scan(
             step,
@@ -485,4 +492,5 @@ def model_from_config(cfg) -> CaptionModel:
         compute_dtype=m.compute_dtype,
         param_dtype=m.param_dtype,
         use_pallas=m.use_pallas_lstm,
+        remat=cfg.train.remat,
     )

@@ -43,15 +43,24 @@ def build_ciderd(force: bool = False) -> str:
             and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)
         ):
             return _LIB
+        # Compile to a process-unique temp path and atomically rename so
+        # concurrent builders (multi-host shared filesystem) never load a
+        # half-written .so.
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
         cmd = [
             "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
-            _SRC, "-o", _LIB,
+            _SRC, "-o", tmp,
         ]
         try:
             subprocess.run(
                 cmd, check=True, capture_output=True, text=True, timeout=120
             )
+            os.replace(tmp, _LIB)
         except (OSError, subprocess.SubprocessError) as e:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
             detail = getattr(e, "stderr", "") or str(e)
             raise NativeUnavailable(f"g++ build failed: {detail}") from e
         return _LIB
@@ -89,6 +98,7 @@ def _load() -> ctypes.CDLL:
         ctypes.c_int,
         ctypes.POINTER(ctypes.c_float),
     ]
+    lib.ciderd_score.restype = ctypes.c_int
     _LIB_HANDLE = lib
     return lib
 
@@ -175,7 +185,7 @@ class NativeCiderD:
         toks = np.ascontiguousarray(token_ids, dtype=np.int32)
         B, L = toks.shape
         out = np.zeros((B,), np.float32)
-        self._lib.ciderd_score(
+        rc = self._lib.ciderd_score(
             self._handle,
             _int_ptr(vidx),
             _int_ptr(toks),
@@ -183,4 +193,10 @@ class NativeCiderD:
             L,
             out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
         )
+        if rc != 0:
+            n = self._lib.ciderd_num_videos(self._handle)
+            raise IndexError(
+                f"video_idx out of range [0, {n}) — rewarder built on a "
+                "different split?"
+            )
         return out

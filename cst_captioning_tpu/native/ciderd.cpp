@@ -207,9 +207,15 @@ int ciderd_num_videos(void* h) {
 // Score a batch: tokens (batch x max_len) int32 rows — candidate stops at
 // the first PAD/EOS, BOS skipped; video_idx (batch,) dataset indices.
 // out (batch,) float32 CIDEr-D x10.
-void ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
-                  int max_len, float* out) {
+// Returns 0 on success, -1 if any video_idx is out of range (the Python
+// wrapper raises IndexError — matching the Python scorer — instead of UB).
+int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
+                 int max_len, float* out) {
   auto* s = static_cast<Scorer*>(h);
+  const int n = static_cast<int>(s->videos.size());
+  for (int b = 0; b < batch; ++b) {
+    if (video_idx[b] < 0 || video_idx[b] >= n) return -1;
+  }
   for (int b = 0; b < batch; ++b) {
     const int* row = tokens + static_cast<long>(b) * max_len;
     std::vector<int> cand;
@@ -230,6 +236,7 @@ void ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
     const double nref = static_cast<double>(v.ref_vecs.size());
     out[b] = static_cast<float>(total / kNGrams / nref * 10.0);
   }
+  return 0;
 }
 
 }  // extern "C"

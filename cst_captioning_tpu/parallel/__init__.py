@@ -28,8 +28,15 @@ from cst_captioning_tpu.parallel.mesh import (  # noqa: F401
 )
 from cst_captioning_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
+    make_placer,
+    put_host_batch,
     replicate,
     shard_batch,
     shard_params,
     param_spec,
 )
+from cst_captioning_tpu.parallel.ring import (  # noqa: F401
+    ring_attention,
+    sharded_context_attention,
+)
+from cst_captioning_tpu.parallel import distributed  # noqa: F401

@@ -1,0 +1,88 @@
+"""Multi-process (multi-host) bootstrap.
+
+The reference has no distributed backend at all (SURVEY.md §2: no
+NCCL/MPI/Gloo — single GPU).  Here multi-host runs ride JAX's standard
+distributed runtime: ``jax.distributed.initialize`` wires the hosts over
+DCN, every process sees the global device set, the mesh spans all chips,
+and collectives ride ICI within a slice / DCN across slices.
+
+Usage (same command on every host; TPU pods autodetect everything):
+
+    from cst_captioning_tpu.parallel import distributed
+    distributed.ensure_initialized()
+    trainer = Trainer(cfg, train_ds, val_ds)   # shards data per process
+
+The data layer composes via ``BatchIterator(shard_id=process_index,
+num_shards=process_count)`` — each host assembles only its shard of every
+global batch, and ``put_host_batch`` assembles the global array with
+``jax.make_array_from_process_local_data`` (parallel/sharding.py).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+log = logging.getLogger("cst_captioning_tpu.parallel")
+
+_INITIALIZED = False
+
+
+def ensure_initialized(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Idempotent ``jax.distributed.initialize``.
+
+    On TPU pods all three arguments autodetect from the metadata server /
+    environment; set them explicitly (or via JAX_COORDINATOR_ADDRESS,
+    JAX_NUM_PROCESSES, JAX_PROCESS_ID) for CPU/GPU clusters.  A
+    single-process run (no coordinator configured) is a no-op.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    explicit = coordinator_address is not None
+    on_tpu_pod = (
+        os.environ.get("TPU_WORKER_HOSTNAMES") is not None
+        or os.environ.get("MEGASCALE_COORDINATOR_ADDRESS") is not None
+    )
+    if not explicit and not on_tpu_pod:
+        log.debug("single-process run; skipping jax.distributed.initialize")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=(
+            num_processes
+            if num_processes is not None
+            else _env_int("JAX_NUM_PROCESSES")
+        ),
+        # `or` would drop an explicit process_id=0 (the coordinator rank).
+        process_id=(
+            process_id if process_id is not None else _env_int("JAX_PROCESS_ID")
+        ),
+    )
+    _INITIALIZED = True
+    log.info(
+        "jax.distributed initialized: process %d/%d, %d local / %d global "
+        "devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v else None
+
+
+def process_shard() -> tuple:
+    """(shard_id, num_shards) for host-sharded data loading."""
+    return jax.process_index(), jax.process_count()

@@ -1,0 +1,167 @@
+"""Sequence/context parallelism: ring attention over a mesh axis.
+
+The reference has no long-context story (SURVEY.md §5: captions <=30
+tokens, <=40 feature frames) — but this framework treats long feature
+streams as first-class: hour-long videos at dense frame rates produce
+sequences that do not fit one chip's HBM, and attention over them must
+shard the SEQUENCE axis, not just the batch.
+
+Two primitives, both exact (not approximations):
+
+* :func:`ring_attention` — blockwise-softmax attention where Q/K/V are
+  sharded along the sequence axis; K/V blocks rotate around the ring via
+  ``ppermute`` (ICI neighbor exchanges, overlapping compute with
+  transfer), with flash-attention-style running (m, l, o) accumulators in
+  float32.  This is the standard ring-attention construction
+  (arXiv:2310.01889) built on ``shard_map`` + XLA collectives.
+* :func:`sharded_context_attention` — the captioner's Bahdanau
+  single-query attention with the FRAME axis sharded: each device scores
+  its local frames and the global softmax is assembled with one psum of
+  (local max, corrected sum, corrected weighted value) — one collective
+  per decode step instead of gathering all frames to every device.
+
+Both are tested for exactness against the dense computation on the
+8-device CPU mesh (tests/test_ring.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def _ring_body(q, k0, v0, kmask0, axis: str, scale: float):
+    """shard_map body: local q (B, Sq, H), rotating k/v (B, Sk, H)."""
+    p = jax.lax.axis_size(axis)
+    B, Sq, H = q.shape
+    qf = q.astype(jnp.float32) * scale
+
+    # Accumulators marked device-varying over the ring axis so shard_map's
+    # varying-axis typing matches across fori_loop iterations (the loop
+    # body's outputs are varying; replicated-typed zeros would not unify).
+    vary = lambda x: jax.lax.pcast(x, axis, to="varying")  # noqa: E731
+    m0 = vary(jnp.full((B, Sq), NEG_INF, jnp.float32))
+    l0 = vary(jnp.zeros((B, Sq), jnp.float32))
+    o0 = vary(jnp.zeros((B, Sq, v0.shape[-1]), jnp.float32))
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(i, carry):
+        m, l, o, k, v, kmask = carry
+        s = jnp.einsum(
+            "bqh,bkh->bqk", qf, k.astype(jnp.float32)
+        )  # (B, Sq, Sk)
+        s = jnp.where(kmask[:, None, :] > 0, s, NEG_INF)
+        s_max = jnp.max(s, axis=-1)                       # (B, Sq)
+        m_new = jnp.maximum(m, s_max)
+        # Renormalize the old accumulators, fold in this block.
+        alpha = jnp.exp(m - m_new)
+        pexp = jnp.exp(s - m_new[..., None])
+        pexp = jnp.where(kmask[:, None, :] > 0, pexp, 0.0)
+        l = l * alpha + pexp.sum(-1)
+        o = o * alpha[..., None] + jnp.einsum(
+            "bqk,bkh->bqh", pexp, v.astype(jnp.float32)
+        )
+        # Rotate K/V (and their mask) one hop around the ring — except on
+        # the final iteration, whose rotated blocks would be discarded.
+        def rotate(args):
+            k_, v_, km_ = args
+            return (
+                jax.lax.ppermute(k_, axis, perm),
+                jax.lax.ppermute(v_, axis, perm),
+                jax.lax.ppermute(km_, axis, perm),
+            )
+
+        k, v, kmask = jax.lax.cond(
+            i < p - 1, rotate, lambda args: args, (k, v, kmask)
+        )
+        return m_new, l, o, k, v, kmask
+
+    m, l, o, _, _, _ = jax.lax.fori_loop(
+        0, p, step, (m0, l0, o0, k0, v0, kmask0)
+    )
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Mesh,
+    axis: str = "model",
+    kv_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Exact attention with Q/K/V (B, S, H) sharded along S over ``axis``.
+
+    ``kv_mask`` (B, S) marks valid key positions (padding excluded).
+    Returns the attention output, sharded like ``q``.  Scale is
+    1/sqrt(head_dim).
+    """
+    if kv_mask is None:
+        kv_mask = jnp.ones(k.shape[:2], jnp.float32)
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    spec = P(None, axis, None)
+    mspec = P(None, axis)
+    fn = jax.shard_map(
+        functools.partial(_ring_body, axis=axis, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec, mspec),
+        out_specs=spec,
+    )
+    return fn(q, k, v, kv_mask)
+
+
+def _ctx_body(query, vals, proj, mask, att_v, axis: str):
+    """shard_map body for single-query Bahdanau attention with the frame
+    axis sharded: local scores + one psum of (max, sum, weighted value).
+
+    query (B, A) replicated; vals (B, Fl, E), proj (B, Fl, A), mask
+    (B, Fl) local frame shards.
+    """
+    s = jnp.tanh(proj + query[:, None, :]) @ att_v          # (B, Fl, 1)
+    s = s[..., 0].astype(jnp.float32)
+    s = jnp.where(mask > 0, s, NEG_INF)
+    m_loc = jnp.max(s, axis=-1)                              # (B,)
+    m = jax.lax.pmax(m_loc, axis)
+    e = jnp.where(mask > 0, jnp.exp(s - m[:, None]), 0.0)
+    l = jax.lax.psum(e.sum(-1), axis)                        # (B,)
+    ctx = jax.lax.psum(
+        jnp.einsum("bf,bfe->be", e, vals.astype(jnp.float32)), axis
+    )
+    return (ctx / jnp.maximum(l, 1e-30)[:, None]).astype(vals.dtype)
+
+
+def sharded_context_attention(
+    query: jax.Array,
+    att_vals: jax.Array,
+    att_proj: jax.Array,
+    att_mask: jax.Array,
+    att_v: jax.Array,
+    mesh: Mesh,
+    axis: str = "model",
+) -> jax.Array:
+    """Frame-sharded Bahdanau context attention (the captioner's per-step
+    fusion, SURVEY.md §2 "Caption model"), exact vs the dense version.
+
+    query (B, A) — projected decoder state (replicated);
+    att_vals (B, F, E) / att_proj (B, F, A) / att_mask (B, F) — sharded
+    along F over ``axis``;  att_v (A, 1) — the scoring vector.
+    """
+    fn = jax.shard_map(
+        functools.partial(_ctx_body, axis=axis),
+        mesh=mesh,
+        in_specs=(
+            P(),
+            P(None, axis, None),
+            P(None, axis, None),
+            P(None, axis),
+            P(),
+        ),
+        out_specs=P(),
+    )
+    return fn(query, att_vals, att_proj, att_mask, att_v)

@@ -1,0 +1,144 @@
+"""Torch-checkpoint import for parity debugging (SURVEY.md §5
+"torch->flax weight-import tool optional for parity debugging" and §7.8).
+
+Maps a PyTorch ``state_dict`` of the reference-style captioner (embedding
++ per-modality linear projections + LSTMCell stack + vocab head +
+optional Bahdanau attention MLP) onto this framework's parameter pytree.
+
+Expected torch key layout (the reference's ``model.py`` modules map onto
+these; rename keys with ``key_map`` for other layouts):
+
+  embed.weight                  (V, E)        -> word_embed
+  feat_proj.<mod>.weight        (E, D_mod)    -> proj_<mod>_w (transposed)
+  feat_proj.<mod>.bias          (E,)          -> proj_<mod>_b
+  lstm.<l>.weight_ih            (4H, D_in)    -> lstm<l>_w rows [:D_in]
+  lstm.<l>.weight_hh            (4H, H)       -> lstm<l>_w rows [D_in:]
+  lstm.<l>.bias_ih / bias_hh    (4H,)         -> lstm<l>_b (summed)
+  logit.weight                  (V, H)        -> logit_w (transposed)
+  logit.bias                    (V,)          -> logit_b
+  att_wf.weight / att_wh.weight / att_b / att_v.weight   (attention MLP)
+  cat_embed.weight              (C, Ce)       -> cat_embed
+
+Gate order is torch's i|f|g|o — identical to ``ops/rnn.py``, so kernels
+import without reordering.  Run:
+  python -m cst_captioning_tpu.tools.import_torch --torch ckpt.pth \\
+      --config cfg.json --out params/
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+
+def _np(t) -> np.ndarray:
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t, np.float32)
+
+
+def import_torch_state_dict(
+    state_dict: Dict[str, object],
+    modalities,
+    num_layers: int,
+    key_map: Optional[Callable[[str], str]] = None,
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """-> flax-style ``{"params": {...}}`` pytree (numpy leaves)."""
+    sd = {
+        (key_map(k) if key_map else k): v for k, v in state_dict.items()
+    }
+
+    def need(key: str) -> np.ndarray:
+        if key not in sd:
+            raise KeyError(
+                f"torch state_dict missing {key!r}; have "
+                f"{sorted(sd)[:10]}..."
+            )
+        return _np(sd[key])
+
+    p: Dict[str, np.ndarray] = {}
+    p["word_embed"] = need("embed.weight")
+    for m in modalities:
+        p[f"proj_{m}_w"] = need(f"feat_proj.{m}.weight").T
+        p[f"proj_{m}_b"] = need(f"feat_proj.{m}.bias")
+    for layer in range(num_layers):
+        w_ih = need(f"lstm.{layer}.weight_ih")  # (4H, D_in)
+        w_hh = need(f"lstm.{layer}.weight_hh")  # (4H, H)
+        p[f"lstm{layer}_w"] = np.concatenate([w_ih.T, w_hh.T], axis=0)
+        b = need(f"lstm.{layer}.bias_ih") + need(f"lstm.{layer}.bias_hh")
+        p[f"lstm{layer}_b"] = b
+    p["logit_w"] = need("logit.weight").T
+    p["logit_b"] = need("logit.bias")
+    if "att_wf.weight" in sd:
+        p["att_wf"] = need("att_wf.weight").T
+        p["att_wh"] = need("att_wh.weight").T
+        p["att_b"] = need("att_b")
+        p["att_v"] = need("att_v.weight").T
+    if "cat_embed.weight" in sd:
+        p["cat_embed"] = need("cat_embed.weight")
+    return {"params": p}
+
+
+def validate_against_model(params, model, sample_inputs) -> None:
+    """Shape-check the imported tree against ``model.init``'s structure."""
+    import jax
+
+    template = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), *sample_inputs)
+    )
+    timported = {k: v.shape for k, v in params["params"].items()}
+    texpected = {
+        k: tuple(v.shape) for k, v in template["params"].items()
+    }
+    if set(timported) != set(texpected):
+        raise ValueError(
+            f"param name mismatch: imported-only "
+            f"{sorted(set(timported) - set(texpected))}, missing "
+            f"{sorted(set(texpected) - set(timported))}"
+        )
+    bad = {
+        k: (timported[k], texpected[k])
+        for k in texpected
+        if tuple(timported[k]) != texpected[k]
+    }
+    if bad:
+        raise ValueError(f"shape mismatches (imported, expected): {bad}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser("import_torch")
+    ap.add_argument("--torch", required=True, help="torch .pth checkpoint")
+    ap.add_argument("--config", required=True, help="framework config json")
+    ap.add_argument("--out", required=True, help="orbax output dir")
+    a = ap.parse_args(argv)
+
+    import torch
+
+    from cst_captioning_tpu.config import Config
+    from cst_captioning_tpu.models.captioner import model_from_config
+
+    cfg = Config.from_json(a.config)
+    sd = torch.load(a.torch, map_location="cpu")
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+    params = import_torch_state_dict(
+        sd, cfg.data.feature_modalities, cfg.model.num_layers
+    )
+    model = model_from_config(cfg)
+
+    import orbax.checkpoint as ocp
+    import os
+
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(
+        os.path.join(os.path.abspath(a.out), "params"), params, force=True
+    )
+    ckptr.wait_until_finished()
+    print(f"imported {len(params['params'])} tensors -> {a.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    main()

@@ -15,16 +15,26 @@ log-probs.  Variants (reference Makefile targets):
 * ``CST_GT_None`` (GT captions as "samples" weighted by consensus) is the
   WXE path in ``training/steps.py`` — no sampling involved.
 
-TPU-first design: the ENTIRE step — S multinomial rollouts, greedy
-baseline decode, reward lookup, PG loss, backward, Adam update — is one
-jitted graph.  The only host work is the CIDEr-D scorer, reached through
-``jax.experimental.io_callback`` (SURVEY.md §3.2: the reference crosses
-device<->host twice per step; here XLA overlaps the callback with device
-compute, and references are pre-cooked at startup).
+Execution strategies (picked automatically):
+
+* **one-graph** — the ENTIRE step (S rollouts, greedy baseline decode,
+  reward lookup, PG loss, backward, Adam) is one jitted graph; the host
+  CIDEr-D scorer is reached through ``jax.experimental.io_callback`` and
+  XLA overlaps it with device compute.
+* **split** — some TPU runtimes (e.g. the tunneled axon PJRT used here)
+  don't implement host send/recv callbacks.  The step then runs as two
+  jitted graphs with host scoring between dispatches — exactly the
+  reference's own loop structure (two device<->host crossings per step,
+  SURVEY.md §3.2) with identical math and negligible overhead (the
+  crossing payload is token ids + a float per sample).
+
+``io_callback_supported()`` probes the backend once per process.
 """
 
 from __future__ import annotations
 
+import functools
+import logging
 from typing import Callable
 
 import jax
@@ -38,19 +48,27 @@ from cst_captioning_tpu.models.captioner import CaptionModel
 from cst_captioning_tpu.ops.losses import reward_criterion
 from cst_captioning_tpu.training.rewards import CiderDRewarder
 
+log = logging.getLogger("cst_captioning_tpu.cst")
 
-def make_cst_train_step(
-    model: CaptionModel, cfg, train_ds
-) -> Callable:
-    """Build the jitted CST step.  Same signature as the XE step
-    (``trainer.py`` dispatch): ``(state, feats, feat_masks, captions,
-    weights, category, video_idx, rng, ss_prob) -> (state, metrics)``;
-    ``captions``/``weights``/``ss_prob`` are unused (sampling-based regime).
-    """
-    rewarder = CiderDRewarder(
-        train_ds,
-        df_mode=cfg.data.idf_file or "corpus",
-    )
+
+@functools.lru_cache(maxsize=None)
+def io_callback_supported() -> bool:
+    """Probe: does the current default backend execute io_callback?"""
+    try:
+        out = jax.jit(
+            lambda x: io_callback(
+                lambda a: np.float32(np.asarray(a) + 1.0),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                x,
+            )
+        )(jnp.float32(1.0))
+        return float(out) == 2.0
+    except Exception as e:
+        log.info("io_callback unsupported on this backend (%s)", e)
+        return False
+
+
+def _validate(cfg):
     S = max(1, cfg.train.cst_num_samples)
     baseline_kind = cfg.train.cst_baseline
     if baseline_kind not in ("greedy", "scb", "none"):
@@ -60,6 +78,72 @@ def make_cst_train_step(
             "cst_baseline='scb' needs cst_num_samples >= 2 (the leave-one-"
             "out consensus baseline is undefined for a single rollout)"
         )
+    return S, baseline_kind
+
+
+def _repeat_batch(feats, feat_masks, category, video_idx, S):
+    feats_r = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
+    masks_r = {m: jnp.repeat(v, S, axis=0) for m, v in feat_masks.items()}
+    cat_r = jnp.repeat(category, S, axis=0) if category is not None else None
+    vid_r = jnp.repeat(video_idx, S, axis=0)
+    return feats_r, masks_r, cat_r, vid_r
+
+
+def _pg_update(state, feats_r, masks_r, cat_r, tokens, mask, advantage,
+               temperature):
+    """PG loss + Adam update: re-run teacher forcing over the SAMPLED
+    tokens so the graph from logits to params is differentiable (the
+    rollout is decode-only).  Input = [BOS, tok_0..tok_{L-2}]."""
+    B = tokens.shape[0]
+    bos = jnp.full((B, 1), BOS_ID, jnp.int32)
+    inputs = jnp.concatenate([bos, tokens[:, :-1]], axis=1)
+    # Finished rows feed EOS, not PAD, to keep embeddings defined.
+    inputs = jnp.where(inputs == PAD_ID, EOS_ID, inputs)
+
+    def loss_fn(params):
+        logits = state.apply_fn(
+            params, feats_r, masks_r, inputs, category=cat_r
+        )
+        # REINFORCE needs log-probs of the distribution that was actually
+        # sampled from: same PAD/BOS masking AND the same temperature
+        # scaling as the rollout policy.
+        logits = CaptionModel.mask_decode_logits(logits) / jnp.asarray(
+            temperature, jnp.float32
+        )
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_lp = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
+        # Post-EOS slots hold PAD (= -inf under the masked policy); zero
+        # them before the masked reduction so 0 * -inf never produces NaN.
+        tok_lp = jnp.where(mask > 0, tok_lp, 0.0)
+        return reward_criterion(tok_lp, mask, advantage)
+
+    loss, grads = jax.value_and_grad(loss_fn)(state.params)
+    gnorm = optax.global_norm(grads)
+    state = state.apply_gradients(grads=grads)
+    return state, loss, gnorm
+
+
+def make_cst_train_step(model: CaptionModel, cfg, train_ds) -> Callable:
+    """Build the CST step.  Same signature as the XE step (``trainer.py``
+    dispatch): ``(state, feats, feat_masks, captions, weights, category,
+    video_idx, rng, ss_prob) -> (state, metrics)``; ``captions`` /
+    ``weights`` / ``ss_prob`` are unused (sampling-based regime)."""
+    rewarder = CiderDRewarder(
+        train_ds, df_mode=cfg.data.idf_file or "corpus"
+    )
+    if io_callback_supported():
+        return _make_one_graph_step(model, cfg, rewarder)
+    log.warning(
+        "backend lacks io_callback support — using the split CST step "
+        "(jitted rollout / host scoring / jitted update)"
+    )
+    return _make_split_step(model, cfg, rewarder)
+
+
+# ------------------------------------------------------- one-graph variant
+
+def _make_one_graph_step(model, cfg, rewarder) -> Callable:
+    S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
 
@@ -77,79 +161,34 @@ def make_cst_train_step(
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
         B = video_idx.shape[0]
-        feats_r = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
-        masks_r = {m: jnp.repeat(v, S, axis=0) for m, v in feat_masks.items()}
-        cat_r = jnp.repeat(category, S, axis=0) if category is not None else None
-        vid_r = jnp.repeat(video_idx, S, axis=0)
-
-        # --- rollouts + rewards (no gradient; recomputed under grad below)
+        feats_r, masks_r, cat_r, vid_r = _repeat_batch(
+            feats, feat_masks, category, video_idx, S
+        )
         rollout = state.apply_fn(
-            state.params,
-            feats_r,
-            masks_r,
-            rng=rng,
-            category=cat_r,
-            max_len=max_len,
-            greedy=False,
-            temperature=temperature,
+            state.params, feats_r, masks_r, rng=rng, category=cat_r,
+            max_len=max_len, greedy=False, temperature=temperature,
             method="sample",
         )
         rewards = score(vid_r, rollout.tokens)  # (B*S,)
 
         if baseline_kind == "greedy":
             greedy = state.apply_fn(
-                state.params,
-                feats,
-                feat_masks,
-                category=category,
-                max_len=max_len,
-                greedy=True,
-                method="sample",
+                state.params, feats, feat_masks, category=category,
+                max_len=max_len, greedy=True, method="sample",
             )
             baseline = jnp.repeat(score(video_idx, greedy.tokens), S, axis=0)
         elif baseline_kind == "scb":
-            # Leave-one-out mean over the video's other rollouts.
             r = rewards.reshape(B, S)
-            if S > 1:
-                loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
-            else:
-                loo = jnp.zeros_like(r)
+            loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
             baseline = loo.reshape(B * S)
         else:
             baseline = jnp.zeros_like(rewards)
         advantage = rewards - baseline
 
-        # --- PG loss: re-run teacher forcing over the SAMPLED tokens so the
-        # graph from logits to params is differentiable (the rollout above
-        # is decode-only).  Input = [BOS, tok_0..tok_{L-2}], target = tokens.
-        bos = jnp.full((B * S, 1), BOS_ID, jnp.int32)
-        inputs = jnp.concatenate([bos, rollout.tokens[:, :-1]], axis=1)
-        # Finished rows feed EOS, not PAD, to keep embeddings defined.
-        inputs = jnp.where(inputs == PAD_ID, EOS_ID, inputs)
-
-        def loss_fn(params):
-            logits = state.apply_fn(
-                params, feats_r, masks_r, inputs, category=cat_r
-            )
-            # REINFORCE needs log-probs of the distribution that was
-            # actually sampled from: same PAD/BOS masking AND the same
-            # temperature scaling as the rollout policy.
-            logits = CaptionModel.mask_decode_logits(logits) / jnp.asarray(
-                temperature, jnp.float32
-            )
-            logp = jax.nn.log_softmax(logits, axis=-1)
-            tok_lp = jnp.take_along_axis(
-                logp, rollout.tokens[..., None], axis=-1
-            )[..., 0]
-            # Post-EOS slots hold PAD (= -inf under the masked policy);
-            # zero them before the masked reduction so 0 * -inf never
-            # produces NaN.
-            tok_lp = jnp.where(rollout.mask > 0, tok_lp, 0.0)
-            return reward_criterion(tok_lp, rollout.mask, advantage)
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        gnorm = optax.global_norm(grads)
-        state = state.apply_gradients(grads=grads)
+        state, loss, gnorm = _pg_update(
+            state, feats_r, masks_r, cat_r, rollout.tokens, rollout.mask,
+            advantage, temperature,
+        )
         return state, {
             "loss": loss,
             "grad_norm": gnorm,
@@ -158,7 +197,84 @@ def make_cst_train_step(
             "advantage": advantage.mean(),
         }
 
-    # ss_prob stays a traced (unused) arg — marking it static would recompile
-    # the whole rollout+backward graph whenever a scheduled-sampling config
-    # ticks its probability.
+    # ss_prob stays a traced (unused) arg — marking it static would
+    # recompile the whole rollout+backward graph whenever a scheduled-
+    # sampling config ticks its probability.
     return jax.jit(train_step, donate_argnums=(0,))
+
+
+# ----------------------------------------------------------- split variant
+
+def _make_split_step(model, cfg, rewarder) -> Callable:
+    S, baseline_kind = _validate(cfg)
+    temperature = cfg.train.sample_temperature
+    max_len = cfg.data.max_seq_len
+    need_greedy = baseline_kind == "greedy"
+
+    @jax.jit
+    def rollout_fn(params, feats, feat_masks, category, rng):
+        feats_r, masks_r, cat_r, _ = _repeat_batch(
+            feats, feat_masks, category, jnp.zeros(1, jnp.int32), S
+        )
+        rollout = model.apply(
+            params, feats_r, masks_r, rng=rng, category=cat_r,
+            max_len=max_len, greedy=False, temperature=temperature,
+            method="sample",
+        )
+        if need_greedy:
+            greedy_tokens = model.apply(
+                params, feats, feat_masks, category=category,
+                max_len=max_len, greedy=True, method="sample",
+            ).tokens
+        else:
+            greedy_tokens = jnp.zeros((1, max_len), jnp.int32)
+        return rollout.tokens, rollout.mask, greedy_tokens
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update_fn(state, feats, feat_masks, category, tokens, mask,
+                  advantage):
+        feats_r, masks_r, cat_r, _ = _repeat_batch(
+            feats, feat_masks, category, jnp.zeros(1, jnp.int32), S
+        )
+        state, loss, gnorm = _pg_update(
+            state, feats_r, masks_r, cat_r, tokens, mask, advantage,
+            temperature,
+        )
+        return state, loss, gnorm
+
+    def train_step(state, feats, feat_masks, captions, weights, category,
+                   video_idx, rng, ss_prob):
+        B = np.asarray(video_idx).shape[0]
+        tokens, mask, greedy_tokens = rollout_fn(
+            state.params, feats, feat_masks, category, rng
+        )
+        vid = np.asarray(video_idx)
+        vid_r = np.repeat(vid, S, axis=0)
+        rewards = rewarder.score_ids(vid_r, np.asarray(tokens)).astype(
+            np.float32
+        )
+        if baseline_kind == "greedy":
+            base = rewarder.score_ids(
+                vid, np.asarray(greedy_tokens)
+            ).astype(np.float32)
+            baseline = np.repeat(base, S, axis=0)
+        elif baseline_kind == "scb":
+            r = rewards.reshape(B, S)
+            loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
+            baseline = loo.reshape(B * S).astype(np.float32)
+        else:
+            baseline = np.zeros_like(rewards)
+        advantage = rewards - baseline
+        state, loss, gnorm = update_fn(
+            state, feats, feat_masks, category, tokens, mask,
+            jnp.asarray(advantage),
+        )
+        return state, {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "reward": jnp.float32(rewards.mean()),
+            "baseline": jnp.float32(baseline.mean()),
+            "advantage": jnp.float32(advantage.mean()),
+        }
+
+    return train_step

@@ -62,9 +62,19 @@ class Trainer:
         train_ds: CaptionDataset,
         val_ds: Optional[CaptionDataset] = None,
         workdir: Optional[str] = None,
-        shard_id: int = 0,
-        num_shards: int = 1,
+        shard_id: Optional[int] = None,
+        num_shards: Optional[int] = None,
     ):
+        # Multi-host default: each process loads its own shard of every
+        # global batch (parallel/distributed.py).  Explicit sharding must
+        # specify both values — a lone shard_id has no defined total.
+        if (shard_id is None) != (num_shards is None):
+            raise ValueError(
+                "pass both shard_id and num_shards, or neither "
+                f"(got shard_id={shard_id}, num_shards={num_shards})"
+            )
+        if num_shards is None:
+            shard_id, num_shards = jax.process_index(), jax.process_count()
         self.cfg = cfg
         self.train_ds = train_ds
         self.val_ds = val_ds
@@ -130,6 +140,8 @@ class Trainer:
         self.history: Dict[str, dict] = {}
         self.best_score = -np.inf
         self.best_epoch = -1
+        # False = armed, True = tracing, None = finished/disabled.
+        self._profiling = False if cfg.train.profile_dir else None
 
     # ------------------------------------------------------------- plumbing
     def _build_steps(self) -> None:
@@ -150,6 +162,21 @@ class Trainer:
 
     def _category(self, batch) -> Optional[jax.Array]:
         return batch.category if self.model.use_category else None
+
+    def _profile_step(self, epoch: int, nsteps: int) -> None:
+        """jax.profiler trace of the first ~10 steps of the first epoch
+        (SURVEY.md §5 "Tracing / profiling" — absent in the reference);
+        closed at epoch end if the epoch is shorter."""
+        if epoch != 0 or self._profiling is None:
+            return
+        if nsteps == 1 and not self._profiling:
+            jax.profiler.start_trace(self.cfg.train.profile_dir)
+            self._profiling = True
+            log.info("profiler trace started -> %s", self.cfg.train.profile_dir)
+        elif nsteps == 11 and self._profiling:
+            jax.profiler.stop_trace()
+            self._profiling = None  # done for this run
+            log.info("profiler trace written to %s", self.cfg.train.profile_dir)
 
     # ------------------------------------------------------------ training
     def train_epoch(self, epoch: int) -> Dict[str, float]:
@@ -185,12 +212,27 @@ class Trainer:
             for k, v in metrics.items():
                 acc.setdefault(k, []).append(v)
             nsteps += 1
+            if cfg.train.nan_check:
+                # Debug guard (SURVEY.md §5 "sanitizers"): forces a host
+                # sync per step — enable only while hunting instabilities.
+                loss_now = float(metrics["loss"])
+                if not np.isfinite(loss_now):
+                    raise FloatingPointError(
+                        f"non-finite loss {loss_now} at epoch {epoch} step "
+                        f"{nsteps} (grad_norm="
+                        f"{float(metrics.get('grad_norm', float('nan')))})"
+                    )
+            if cfg.train.profile_dir:
+                self._profile_step(epoch, nsteps)
             if nsteps % cfg.train.log_every == 0:
                 log.info(
                     "epoch %d step %d loss %.4f (%.2f steps/s)",
                     epoch, nsteps, float(metrics["loss"]),
                     nsteps / (time.time() - t0),
                 )
+        if self._profiling:  # epoch ended before the trace window closed
+            jax.profiler.stop_trace()
+            self._profiling = None
         out = {
             f"train_{k}" if k == "loss" else k: float(
                 np.mean([float(x) for x in v])

@@ -1,0 +1,142 @@
+"""Aux subsystems (SURVEY.md §5) + pipeline runner: remat equivalence,
+nan guard, profiler traces, staged XE->WXE->CST pipeline."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config import get_preset
+from cst_captioning_tpu.data import make_synthetic_dataset
+from cst_captioning_tpu.models import CaptionModel
+from cst_captioning_tpu.training import Trainer
+
+
+class TestRemat:
+    def test_forward_and_grads_match(self):
+        rng = np.random.RandomState(0)
+        V, B, T, F, D, H = 19, 4, 6, 5, 8, 12
+        feats = {"resnet": jnp.asarray(rng.randn(B, F, D), jnp.float32)}
+        masks = {"resnet": jnp.ones((B, F))}
+        ids = jnp.asarray(rng.randint(4, V, (B, T)), jnp.int32).at[:, 0].set(1)
+
+        def build(remat):
+            return CaptionModel(
+                vocab_size=V, rnn_size=H, num_layers=1, embed_size=H,
+                modalities=("resnet",), feature_dims=(D,), drop_prob=0.0,
+                compute_dtype="float32", remat=remat,
+            )
+
+        m0, m1 = build(False), build(True)
+        params = m0.init(jax.random.PRNGKey(0), feats, masks, ids)
+        np.testing.assert_allclose(
+            np.asarray(m0.apply(params, feats, masks, ids)),
+            np.asarray(m1.apply(params, feats, masks, ids)),
+            rtol=1e-6,
+        )
+        g0 = jax.grad(lambda p: jnp.sum(m0.apply(p, feats, masks, ids) ** 2))(
+            params
+        )
+        g1 = jax.grad(lambda p: jnp.sum(m1.apply(p, feats, masks, ids) ** 2))(
+            params
+        )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            g0,
+            g1,
+        )
+
+    def test_config_plumbing(self):
+        from cst_captioning_tpu.models import model_from_config
+
+        cfg = get_preset("synthetic_smoke")
+        cfg.model.vocab_size = 10
+        cfg.train.remat = True
+        assert model_from_config(cfg).remat is True
+
+
+def smoke_trainer(tmp_path, **over):
+    ds, _ = make_synthetic_dataset(num_videos=16, max_frames=6, seed=1)
+    cfg = get_preset("synthetic_smoke")
+    cfg.data.batch_size = 8
+    cfg.data.seq_per_img = 2
+    cfg.train.checkpoint_dir = str(tmp_path / "ck")
+    cfg.train.max_epochs = 1
+    cfg.train.max_patience = 0
+    cfg.eval.metrics = ["CIDEr"]
+    cfg.eval.max_decode_len = 11
+    for k, v in over.items():
+        setattr(cfg.train, k, v)
+    return ds, cfg
+
+
+class TestNanCheck:
+    def test_raises_on_nonfinite_loss(self, tmp_path):
+        ds, cfg = smoke_trainer(tmp_path, nan_check=True)
+        t = Trainer(cfg, train_ds=ds, val_ds=None,
+                    workdir=str(tmp_path / "w"))
+        real_step = t._train_step
+
+        def poisoned(*args, **kw):
+            state, metrics = real_step(*args, **kw)
+            metrics["loss"] = jnp.float32(float("nan"))
+            return state, metrics
+
+        t._train_step = poisoned
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            t.fit()
+
+    def test_clean_run_passes(self, tmp_path):
+        ds, cfg = smoke_trainer(tmp_path, nan_check=True)
+        t = Trainer(cfg, train_ds=ds, val_ds=None,
+                    workdir=str(tmp_path / "w2"))
+        hist = t.fit()
+        assert np.isfinite(hist["0"]["train_loss"])
+
+
+class TestProfiler:
+    def test_trace_written(self, tmp_path):
+        prof = str(tmp_path / "prof")
+        ds, cfg = smoke_trainer(tmp_path, profile_dir=prof)
+        t = Trainer(cfg, train_ds=ds, val_ds=None,
+                    workdir=str(tmp_path / "w3"))
+        t.fit()
+        traces = glob.glob(os.path.join(prof, "**", "*"), recursive=True)
+        assert any(os.path.isfile(p) for p in traces), "no trace files"
+
+
+class TestPipeline:
+    def test_staged_pipeline_runs_and_evaluates(self, tmp_path):
+        from cst_captioning_tpu.cli.pipeline import run_pipeline
+
+        cfg = get_preset("synthetic_smoke")
+        cfg.data.batch_size = 8
+        cfg.data.seq_per_img = 2
+        cfg.data.max_seq_len = 11
+        cfg.train.checkpoint_dir = str(tmp_path / "ck")
+        cfg.train.max_epochs = 1
+        cfg.train.max_patience = 0
+        cfg.train.cst_num_samples = 2
+        cfg.eval.metrics = ["CIDEr"]
+        cfg.eval.beam_size = 2
+        cfg.eval.max_decode_len = 11
+        results = run_pipeline(cfg, ["xe", "wxe", "cst_greedy"],
+                               eval_split="test")
+        assert set(results) == {"xe", "wxe", "cst_greedy", "eval"}
+        # every stage trained and checkpointed
+        for stage in ("xe", "wxe", "cst_greedy"):
+            wd = os.path.join(
+                cfg.train.checkpoint_dir, f"{cfg.name}_{stage}"
+            )
+            assert os.path.exists(os.path.join(wd, "best")) or os.path.exists(
+                os.path.join(wd, "last")
+            )
+        assert "CIDEr" in results["eval"]["scores"]
+        assert os.path.exists(
+            os.path.join(results["eval"]["out_dir"], "scores.json")
+        )

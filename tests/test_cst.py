@@ -102,6 +102,66 @@ def xe_pretrain(ds, tmp_path, epochs=60):
     return t
 
 
+class TestSplitStep:
+    """The split (no-io_callback) CST path must match the one-graph path
+    exactly: same rng -> same rollout -> same rewards -> same update."""
+
+    @pytest.mark.parametrize("baseline", ["greedy", "scb"])
+    def test_split_matches_one_graph(self, corpus, tmp_path, baseline):
+        import jax.numpy as jnp
+
+        from cst_captioning_tpu.data import BatchIterator
+        from cst_captioning_tpu.models import model_from_config
+        from cst_captioning_tpu.training.cst import (
+            _make_one_graph_step,
+            _make_split_step,
+        )
+        from cst_captioning_tpu.training.rewards import CiderDRewarder
+        from cst_captioning_tpu.training.steps import (
+            create_train_state,
+            make_optimizer,
+        )
+
+        ds, _ = corpus
+        cfg = cst_cfg(tmp_path, baseline)
+        cfg.model.vocab_size = len(ds.vocab)
+        model = model_from_config(cfg)
+        it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
+                           shuffle=False)
+        batch = next(iter(it.epoch(0)))
+        tx = make_optimizer(cfg.train, 10)
+        rewarder = CiderDRewarder(ds)
+        rng = jax.random.PRNGKey(3)
+
+        def run(step_fn):
+            state = create_train_state(
+                jax.random.PRNGKey(0), model, tx, batch._asdict()
+            )
+            return step_fn(
+                state, batch.feats, batch.feat_masks, batch.captions,
+                batch.weights, None, batch.video_idx, rng, 0.0,
+            )
+
+        s1, m1 = run(_make_one_graph_step(model, cfg, rewarder))
+        s2, m2 = run(_make_split_step(model, cfg, rewarder))
+        for k in ("loss", "reward", "baseline"):
+            np.testing.assert_allclose(
+                float(m1[k]), float(m2[k]), rtol=1e-5, atol=1e-7
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            s1.params,
+            s2.params,
+        )
+
+    def test_probe_runs(self):
+        from cst_captioning_tpu.training.cst import io_callback_supported
+
+        assert io_callback_supported() is True  # CPU supports it
+
+
 class TestCSTTraining:
     @pytest.mark.parametrize("baseline", ["greedy", "scb", "none"])
     def test_step_runs_and_reports_reward(self, corpus, tmp_path, baseline):

@@ -1,0 +1,115 @@
+"""Torch-weight import: full-model forward parity against a torch twin of
+the meanpool captioner (embedding + projection + LSTMCell + vocab head)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from cst_captioning_tpu.models import CaptionModel  # noqa: E402
+from cst_captioning_tpu.tools.import_torch import (  # noqa: E402
+    import_torch_state_dict,
+    validate_against_model,
+)
+
+V, B, T, F, D, H = 21, 3, 6, 4, 10, 12
+
+
+class TorchTwin(torch.nn.Module):
+    """Torch replica of CaptionModel's meanpool forward (reference-style
+    modules producing the documented state_dict layout)."""
+
+    def __init__(self):
+        super().__init__()
+        self.embed = torch.nn.Embedding(V, H)
+        self.feat_proj = torch.nn.ModuleDict(
+            {"resnet": torch.nn.Linear(D, H)}
+        )
+        self.lstm = torch.nn.ModuleList([torch.nn.LSTMCell(2 * H, H)])
+        self.logit = torch.nn.Linear(H, V)
+
+    def forward(self, feats, ids):
+        ctx = feats.mean(dim=1)
+        ctx = self.feat_proj["resnet"](ctx)  # NOTE: proj after meanpool
+        emb = self.embed(ids)
+        h = torch.zeros(ids.shape[0], H)
+        c = torch.zeros(ids.shape[0], H)
+        outs = []
+        for t in range(ids.shape[1]):
+            x = torch.cat([emb[:, t], ctx], dim=-1)
+            h, c = self.lstm[0](x, (h, c))
+            outs.append(self.logit(h))
+        return torch.stack(outs, dim=1)
+
+    def framework_state_dict(self):
+        sd = {}
+        sd["embed.weight"] = self.embed.weight
+        sd["feat_proj.resnet.weight"] = self.feat_proj["resnet"].weight
+        sd["feat_proj.resnet.bias"] = self.feat_proj["resnet"].bias
+        sd["lstm.0.weight_ih"] = self.lstm[0].weight_ih
+        sd["lstm.0.weight_hh"] = self.lstm[0].weight_hh
+        sd["lstm.0.bias_ih"] = self.lstm[0].bias_ih
+        sd["lstm.0.bias_hh"] = self.lstm[0].bias_hh
+        sd["logit.weight"] = self.logit.weight
+        sd["logit.bias"] = self.logit.bias
+        return sd
+
+
+class TestImport:
+    def test_full_forward_parity(self):
+        """Import a torch twin's weights; logits must match the jax model.
+
+        The twin mean-pools BEFORE projecting; our model projects each
+        frame then mean-pools — identical math for a linear projection
+        with full frame masks, so outputs must agree to float tolerance.
+        """
+        torch.manual_seed(0)
+        twin = TorchTwin()
+        rng = np.random.RandomState(1)
+        feats_np = rng.randn(B, F, D).astype(np.float32)
+        ids_np = rng.randint(4, V, size=(B, T)).astype(np.int64)
+        ids_np[:, 0] = 1
+
+        with torch.no_grad():
+            ref = twin(
+                torch.from_numpy(feats_np), torch.from_numpy(ids_np)
+            ).numpy()
+
+        params = import_torch_state_dict(
+            twin.framework_state_dict(), ["resnet"], num_layers=1
+        )
+        model = CaptionModel(
+            vocab_size=V, rnn_size=H, num_layers=1, embed_size=H,
+            modalities=("resnet",), feature_dims=(D,), drop_prob=0.0,
+            compute_dtype="float32",
+        )
+        feats = {"resnet": jnp.asarray(feats_np)}
+        masks = {"resnet": jnp.ones((B, F))}
+        ids = jnp.asarray(ids_np, jnp.int32)
+        validate_against_model(params, model, (feats, masks, ids))
+        params_j = jax.tree.map(jnp.asarray, params)
+        got = model.apply(params_j, feats, masks, ids)
+        np.testing.assert_allclose(np.asarray(got), ref, rtol=1e-4, atol=1e-4)
+
+    def test_validate_catches_shape_mismatch(self):
+        torch.manual_seed(0)
+        twin = TorchTwin()
+        params = import_torch_state_dict(
+            twin.framework_state_dict(), ["resnet"], num_layers=1
+        )
+        model = CaptionModel(
+            vocab_size=V, rnn_size=H + 1, num_layers=1, embed_size=H,
+            modalities=("resnet",), feature_dims=(D,), drop_prob=0.0,
+            compute_dtype="float32",
+        )
+        feats = {"resnet": jnp.zeros((1, F, D))}
+        masks = {"resnet": jnp.ones((1, F))}
+        ids = jnp.zeros((1, 2), jnp.int32)
+        with pytest.raises(ValueError):
+            validate_against_model(params, model, (feats, masks, ids))
+
+    def test_missing_key_reported(self):
+        with pytest.raises(KeyError, match="embed.weight"):
+            import_torch_state_dict({}, ["resnet"], num_layers=1)

@@ -94,6 +94,13 @@ class TestGuards:
         with pytest.raises(NativeUnavailable):
             NativeCiderD([[[MAX_TOKEN_ID + 1]]])
 
+    def test_out_of_range_video_idx_raises(self, corpus, built):
+        ds, _ = corpus
+        nat = CiderDRewarder(ds, backend="native")
+        toks = np.zeros((1, 5), np.int32)
+        with pytest.raises(IndexError, match="out of range"):
+            nat.score_ids(np.asarray([len(ds)], np.int32), toks)
+
     def test_auto_backend_never_raises(self, corpus):
         ds, _ = corpus
         rw = CiderDRewarder(ds, backend="auto")
