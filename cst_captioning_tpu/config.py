@@ -94,6 +94,7 @@ class TrainConfig:
     save_checkpoint_every: int = 1
     checkpoint_dir: str = "checkpoints"
     start_from: str = ""          # warm-start checkpoint (XE -> WXE -> CST staging)
+    resume: bool = False          # continue from <workdir>/last (preemption)
     seed: int = 213
 
     # Parallelism over the device mesh (reference: .cuda()/DataParallel only).

@@ -112,16 +112,29 @@ def ciderd_score_vec(
     doc_freq,
     log_ref_len: float,
     use_d: bool = True,
+    ref_weights=None,
 ) -> float:
-    """Score one cooked candidate against pre-vectorized refs. Scale x10."""
+    """Score one cooked candidate against pre-vectorized refs. Scale x10.
+
+    ``ref_weights``: optional per-reference weights (the paper's weighted
+    consensus reward — each reference's similarity counts proportionally
+    to its consensus score).  They are normalized to sum 1 here; ``None``
+    is the uniform 1/N mean.
+    """
     vec, norm, length = _counts2vec(ctest, doc_freq, log_ref_len)
     score = np.zeros(NGRAMS)
-    for vec_r, norm_r, len_r in ref_vecs:
+    if ref_weights is None:
+        w = np.full(len(ref_vecs), 1.0 / len(ref_vecs))
+    else:
+        w = np.asarray(ref_weights, np.float64)
+        total = w.sum()
+        w = w / total if total > 1e-12 else np.full_like(w, 1.0 / len(w))
+    for w_r, (vec_r, norm_r, len_r) in zip(w, ref_vecs):
         if use_d:
-            score += _sim_d(vec, vec_r, norm, norm_r, length, len_r)
+            score += w_r * _sim_d(vec, vec_r, norm, norm_r, length, len_r)
         else:
-            score += _sim_plain(vec, vec_r, norm, norm_r)
-    return float(np.mean(score) / len(ref_vecs) * 10.0)
+            score += w_r * _sim_plain(vec, vec_r, norm, norm_r)
+    return float(np.mean(score) * 10.0)
 
 
 def ciderd_score_cooked(

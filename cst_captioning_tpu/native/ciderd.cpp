@@ -45,6 +45,8 @@ struct RefVec {
 struct Video {
   std::vector<std::vector<int>> refs;   // token ids per reference
   std::vector<RefVec> ref_vecs;         // cooked at finalize()
+  std::vector<float> weights;           // per-ref consensus weights
+                                        // (empty = uniform)
 };
 
 struct Scorer {
@@ -134,6 +136,15 @@ void ciderd_add_video(void* h, const int* tokens, const int* ref_lens,
     off += ref_lens[r];
   }
   s->videos.push_back(std::move(v));
+}
+
+// Optional per-reference consensus weights for the most recently added
+// video (the paper's weighted-consensus reward).  Normalized at score
+// time; call after ciderd_add_video.
+void ciderd_set_video_weights(void* h, int video, const float* w, int n) {
+  auto* s = static_cast<Scorer*>(h);
+  if (video < 0 || video >= static_cast<int>(s->videos.size())) return;
+  s->videos[video].weights.assign(w, w + n);
 }
 
 // Corpus-mode finalize: df[ngram] = number of videos whose ref set
@@ -231,10 +242,23 @@ int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
     RefVec hyp;
     counts_to_vec(cnts, s->doc_freq, s->log_ref_len, &hyp);
     const Video& v = s->videos[video_idx[b]];
+    const size_t nref = v.ref_vecs.size();
     double total = 0.0;
-    for (const auto& rv : v.ref_vecs) total += sim_d(hyp, rv);
-    const double nref = static_cast<double>(v.ref_vecs.size());
-    out[b] = static_cast<float>(total / kNGrams / nref * 10.0);
+    if (v.weights.size() == nref && nref > 0) {
+      double wsum = 0.0;
+      for (float w : v.weights) wsum += w;
+      const bool degenerate = wsum <= 1e-12;
+      for (size_t r = 0; r < nref; ++r) {
+        const double w =
+            degenerate ? 1.0 / nref : v.weights[r] / wsum;
+        total += w * sim_d(hyp, v.ref_vecs[r]);
+      }
+      out[b] = static_cast<float>(total / kNGrams * 10.0);
+    } else {
+      for (const auto& rv : v.ref_vecs) total += sim_d(hyp, rv);
+      out[b] = static_cast<float>(
+          total / kNGrams / static_cast<double>(nref) * 10.0);
+    }
   }
   return 0;
 }

@@ -25,6 +25,16 @@ def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+def _abstract(x):
+    """Shape/dtype struct carrying the template's sharding, so restored
+    arrays land exactly where the live state's arrays are (mesh-sharded
+    params, replicated opt counters, ...)."""
+    if isinstance(x, jax.Array):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    x = jnp.asarray(x)
+    return jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
 def save_checkpoint(path: str, state, extra: Optional[Dict[str, Any]] = None
                     ) -> None:
     """Save a TrainState: params + (opt_state, step) + json sidecar."""
@@ -56,15 +66,13 @@ def restore_checkpoint(path: str, state):
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(
         os.path.join(path, "params"),
-        jax.tree.map(ocp.utils.to_shape_dtype_struct, state.params),
+        jax.tree.map(_abstract, state.params),
     )
     opt = ckptr.restore(
         os.path.join(path, "opt"),
         {
-            "opt_state": jax.tree.map(
-                ocp.utils.to_shape_dtype_struct, state.opt_state
-            ),
-            "step": ocp.utils.to_shape_dtype_struct(jnp.asarray(state.step)),
+            "opt_state": jax.tree.map(_abstract, state.opt_state),
+            "step": _abstract(state.step),
         },
     )
     return state.replace(
@@ -80,5 +88,5 @@ def restore_params(path: str, params_template):
     ckptr = ocp.StandardCheckpointer()
     return ckptr.restore(
         os.path.join(path, "params"),
-        jax.tree.map(ocp.utils.to_shape_dtype_struct, params_template),
+        jax.tree.map(_abstract, params_template),
     )

@@ -100,11 +100,30 @@ def create_train_state(
         else None
     )
     params = model.init(rng, feats, masks, ids, category=cat)
-    if mesh is not None:
-        from cst_captioning_tpu.parallel.sharding import shard_params
+    if mesh is None:
+        return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
-        params = shard_params(params, mesh)
-    return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from cst_captioning_tpu.parallel.sharding import shard_params
+
+    params = shard_params(params, mesh)
+    state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
+    # Adam moments inherit each param's sharding (zeros_like of sharded
+    # params), but optax's scalar counters are created on the default
+    # device; replicate them over the mesh so every state leaf has a
+    # consistent committed placement (checkpoint restore preserves leaf
+    # shardings — mixed placements would clash after resume).
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def place(x):
+        if isinstance(x, jax.Array) and not isinstance(
+            x.sharding, NamedSharding
+        ):
+            return jax.device_put(x, rep)
+        return x
+
+    return state.replace(opt_state=jax.tree.map(place, state.opt_state))
 
 
 def _flatten_batch(model: CaptionModel, feats, feat_masks, captions, weights,

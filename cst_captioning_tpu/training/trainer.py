@@ -123,8 +123,11 @@ class Trainer:
             self.mesh = None
             self._batch_sharding = None
 
-        rng = jax.random.PRNGKey(cfg.train.seed)
-        self.rng, init_rng = jax.random.split(rng)
+        # All training randomness is derived per (seed, epoch, step) via
+        # fold_in — resume-from-checkpoint reproduces the exact stream an
+        # uninterrupted run would have used.
+        self._base_rng = jax.random.PRNGKey(cfg.train.seed)
+        init_rng = jax.random.fold_in(self._base_rng, 0x5EED)
         first = next(iter(self.train_iter.epoch(0)))
         self.state = create_train_state(
             init_rng, self.model, self.tx, first._asdict(), mesh=self.mesh
@@ -140,10 +143,39 @@ class Trainer:
         self.history: Dict[str, dict] = {}
         self.best_score = -np.inf
         self.best_epoch = -1
+        self.start_epoch = 0
+        self._patience = 0
+        if cfg.train.resume:
+            self._try_resume()
         # False = armed, True = tracing, None = finished/disabled.
         self._profiling = False if cfg.train.profile_dir else None
 
     # ------------------------------------------------------------- plumbing
+    def _try_resume(self) -> None:
+        """Preemption recovery (SURVEY.md §5 "resume-from-checkpoint"):
+        restore params+optimizer+step from <workdir>/last, continue at the
+        next epoch with the best-score/patience counters reinstated."""
+        last = os.path.join(self.workdir, "last")
+        infos = ckpt.load_infos(last)
+        if not infos:
+            log.info("resume requested but no checkpoint at %s — fresh run",
+                     last)
+            return
+        self.state = ckpt.restore_checkpoint(last, self.state)
+        self.start_epoch = int(infos["epoch"]) + 1
+        bs = infos.get("best_score")
+        self.best_score = -np.inf if bs is None else float(bs)
+        self.best_epoch = int(infos.get("best_epoch", -1))
+        self._patience = int(infos.get("patience", 0))
+        hist_path = os.path.join(self.workdir, self.cfg.train.history_file)
+        if os.path.exists(hist_path):
+            with open(hist_path) as f:
+                self.history = json.load(f)
+        log.info(
+            "resumed from %s: continuing at epoch %d (step %d, best %.4f)",
+            last, self.start_epoch, int(self.state.step), self.best_score,
+        )
+
     def _build_steps(self) -> None:
         mode = self.cfg.train.train_mode
         if mode in ("xe", "wxe"):
@@ -189,10 +221,11 @@ class Trainer:
         acc: Dict[str, List[jax.Array]] = {}
         t0 = time.time()
         nsteps = 0
+        epoch_rng = jax.random.fold_in(self._base_rng, epoch)
         for batch in prefetch_to_device(
             self.train_iter.epoch(epoch), sharding=self._batch_sharding
         ):
-            self.rng, step_rng = jax.random.split(self.rng)
+            step_rng = jax.random.fold_in(epoch_rng, nsteps)
             weights = (
                 batch.weights
                 if use_weights
@@ -268,8 +301,7 @@ class Trainer:
     # ----------------------------------------------------------------- fit
     def fit(self) -> Dict[str, dict]:
         cfg = self.cfg
-        patience = 0
-        for epoch in range(cfg.train.max_epochs):
+        for epoch in range(self.start_epoch, cfg.train.max_epochs):
             entry = self.train_epoch(epoch)
             if self.val_ds is not None and (epoch + 1) % cfg.train.eval_every == 0:
                 val = self.evaluate()
@@ -278,14 +310,14 @@ class Trainer:
                 if score > self.best_score:
                     self.best_score = score
                     self.best_epoch = epoch
-                    patience = 0
+                    self._patience = 0
                     ckpt.save_checkpoint(
                         os.path.join(self.workdir, "best"),
                         self.state,
                         {"epoch": epoch, "val": val, "config": cfg.to_dict()},
                     )
                 else:
-                    patience += 1
+                    self._patience += 1
                 log.info(
                     "epoch %d val %s (best CIDEr %.4f @ %d)",
                     epoch, {k: round(v, 4) for k, v in val.items()},
@@ -295,7 +327,17 @@ class Trainer:
                 ckpt.save_checkpoint(
                     os.path.join(self.workdir, "last"),
                     self.state,
-                    {"epoch": epoch, "history": entry},
+                    {
+                        "epoch": epoch,
+                        "history": entry,
+                        "best_score": (
+                            None
+                            if self.best_score == -np.inf
+                            else self.best_score
+                        ),
+                        "best_epoch": self.best_epoch,
+                        "patience": self._patience,
+                    },
                 )
             self.history[str(epoch)] = entry
             with open(
@@ -305,7 +347,7 @@ class Trainer:
             if (
                 self.val_ds is not None
                 and cfg.train.max_patience > 0
-                and patience >= cfg.train.max_patience
+                and self._patience >= cfg.train.max_patience
             ):
                 log.info("early stop at epoch %d", epoch)
                 break
