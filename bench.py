@@ -276,6 +276,43 @@ def bench_cst():
     }
 
 
+def bench_loader():
+    """Host batch assembly from the packed feature store at MSR-VTT shape
+    (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
+    The bar (VERDICT r1 #6): assembly must be well under the TPU step time
+    so the prefetch thread hides it completely."""
+    import shutil
+    import tempfile
+
+    from cst_captioning_tpu.data.packed import PackedSource, pack_modality
+
+    V, F, B = 128, 28, 64
+    dims = {"resnet": 2048, "c3d": 4096}
+    tmp = tempfile.mkdtemp(prefix="bench_packed_")
+    try:
+        rng = np.random.RandomState(0)
+        srcs = {}
+        for m, D in dims.items():
+            pack_modality(
+                tmp, m, [f"v{i}" for i in range(V)],
+                (rng.randn(F, D).astype(np.float16) for _ in range(V)),
+                F, D, dtype="float16",
+            )
+            srcs[m] = PackedSource(tmp, m)
+        idxs = rng.permutation(V)[:B]
+        for src in srcs.values():  # warm the page cache
+            src.get_batch(idxs, F)
+        times = []
+        for _ in range(7):
+            t0 = time.perf_counter()
+            for src in srcs.values():
+                src.get_batch(idxs, F)
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2] * 1e3
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def load_round_baseline(metric: str, unit: str):
     """Earliest recorded round for this metric.  Driver artifacts are
     zero-padded (BENCH_r01.json) and wrap the line under "parsed"."""
@@ -316,6 +353,15 @@ def main() -> int:
             extra.update(bench_cst())
         except Exception as e:  # CST bench must never sink the headline
             extra["cst_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_LOADER", "1") == "1":
+        try:
+            ms = bench_loader()
+            extra["loader_packed_assembly_ms"] = round(ms, 2)
+            extra["loader_vs_step_time"] = round(
+                ms / (1e3 / sps_chip / max(1, len(jax.devices()))), 4
+            )
+        except Exception as e:
+            extra["loader_error"] = f"{type(e).__name__}: {e}"
 
     prev = load_round_baseline(metric, unit)
     vs = sps_chip / prev if prev else 1.0

@@ -54,7 +54,8 @@ def main(argv=None) -> int:
         model, params, ds, cfg, out_dir=cfg.eval.out_dir
     )
     for k, v in scores.items():
-        print(f"{k}: {v:.4f}")
+        # Non-numeric entries (e.g. METEOR_backend) print verbatim.
+        print(f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}")
     return 0
 
 

@@ -65,6 +65,10 @@ class ModelConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"   # MXU-friendly activations
     use_pallas_lstm: bool = False     # fused Pallas LSTM cell fast path
+    # Shard the attention-fusion frame axis over the mesh "model" axis
+    # (sequence/context parallelism for long feature streams; requires
+    # feature_fusion="attention" and a multi-device mesh).
+    shard_frames: bool = False
 
 
 @dataclass
@@ -75,7 +79,13 @@ class TrainConfig:
     # CST sub-switches (reference CST_* Makefile targets):
     cst_baseline: str = "greedy"  # greedy (SCST/CST_MS_Greedy) | scb (CST_MS_SCB) | none (CST_GT_None)
     cst_num_samples: int = 20     # multinomial rollouts per video (CST_MS)
-    cst_use_gt: bool = False      # CST_GT_None: "samples" are the GT captions
+    # CST_GT_None: the "samples" are the GT captions themselves, weighted by
+    # consensus — mathematically the WXE regime; train_mode="cst" with this
+    # flag dispatches to the weighted-XE step (trainer._build_steps).
+    cst_use_gt: bool = False
+    # Weight each reference's CIDEr-D contribution to the CST reward by its
+    # consensus weight (driver config 4: "20-ref weighted CIDEr").
+    cst_weighted_reward: bool = False
     sample_temperature: float = 1.0
 
     optimizer: str = "adam"
@@ -223,6 +233,7 @@ def _preset_msrvtt_cst_ms() -> Config:
     c.train.train_mode = "cst"
     c.train.cst_baseline = "scb"
     c.train.cst_num_samples = 20
+    c.train.cst_weighted_reward = True  # 20-ref weighted CIDEr reward
     c.train.learning_rate = 1e-4
     c.train.start_from = "checkpoints/msrvtt_wxe_cst_gt_none/best"
     return c

@@ -3,7 +3,10 @@
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+import json
+from typing import Dict, Optional, Tuple
+
+import numpy as np
 
 from cst_captioning_tpu.config import Config
 from cst_captioning_tpu.data.datasets import (
@@ -14,6 +17,48 @@ from cst_captioning_tpu.data.datasets import (
 from cst_captioning_tpu.data.vocab import Vocabulary
 
 
+def load_consensus_weights(
+    path: str, ds: CaptionDataset
+) -> Dict[str, np.ndarray]:
+    """Load per-caption consensus weights (the reference's precomputed WXE
+    CIDEr scores, SURVEY.md §3.4) and key them by video id.
+
+    Formats: ``.json`` — {video_id: [w, ...]}; ``.npy`` — one flat float
+    array aligned with the dataset's caption rows in dataset order (the
+    label-h5 ``captions`` layout written by ``tools/prepare_data.py``).
+    """
+    if path.endswith(".json"):
+        with open(path) as f:
+            raw = json.load(f)
+        out = {k: np.asarray(v, np.float32) for k, v in raw.items()}
+        # Validate counts for every covered video — a short vector would
+        # otherwise IndexError (or silently misalign) at caption-sampling
+        # time deep inside the training loop.
+        by_id = {ds.video_id(i): i for i in range(len(ds))}
+        for vid, w in out.items():
+            if vid in by_id:
+                n = ds.captions(by_id[vid]).shape[0]
+                if w.shape[0] != n:
+                    raise ValueError(
+                        f"consensus file {path}: video {vid!r} has "
+                        f"{w.shape[0]} weights but {n} captions"
+                    )
+        return out
+    flat = np.load(path).astype(np.float32)
+    out: Dict[str, np.ndarray] = {}
+    pos = 0
+    for i in range(len(ds)):
+        n = ds.captions(i).shape[0]
+        out[ds.video_id(i)] = flat[pos : pos + n]
+        pos += n
+    if pos != flat.shape[0]:
+        raise ValueError(
+            f"consensus file {path} has {flat.shape[0]} weights but the "
+            f"dataset's caption rows total {pos}"
+        )
+    return out
+
+
 def build_dataset(
     cfg: Config, split: str, vocab: Optional[Vocabulary] = None
 ) -> Tuple[CaptionDataset, Vocabulary]:
@@ -21,7 +66,11 @@ def build_dataset(
     corpus (split names map to different seeds so train/val differ);
     otherwise ``data.label_file`` is a path template with a ``{split}``
     placeholder (as written by ``tools/prepare_data.py``) or a literal
-    path, and ``data.feature_files`` maps modality -> feature h5."""
+    path, and ``data.feature_files`` maps modality -> feature h5.
+
+    ``data.consensus_file`` (optional, train split only; ``{split}``
+    template allowed) overrides the per-caption consensus weights used by
+    WXE / the weighted CST reward."""
     d = cfg.data
     if d.dataset == "synthetic":
         seed = {"train": 0, "val": 1, "test": 2}.get(split, 3)
@@ -33,11 +82,19 @@ def build_dataset(
             num_categories=d.num_categories if cfg.model.use_category else 0,
             seed=seed,
         )
-        return ds, (vocab or vb)
-    if vocab is None:
-        if not d.vocab_file:
-            raise ValueError("data.vocab_file is required for h5 datasets")
-        vocab = Vocabulary.load(d.vocab_file)
-    label = d.label_file.format(split=split)
-    ds = H5Dataset(label, dict(d.feature_files), vocab)
-    return ds, vocab
+        ds_out: CaptionDataset = ds
+        vocab = vocab or vb
+    else:
+        if vocab is None:
+            if not d.vocab_file:
+                raise ValueError("data.vocab_file is required for h5 datasets")
+            vocab = Vocabulary.load(d.vocab_file)
+        label = d.label_file.format(split=split)
+        ds_out = H5Dataset(label, dict(d.feature_files), vocab)
+    if d.consensus_file and split == "train":
+        ds_out.set_caption_weights(
+            load_consensus_weights(
+                d.consensus_file.format(split=split), ds_out
+            )
+        )
+    return ds_out, vocab

@@ -25,6 +25,11 @@ class CaptionDataset:
 
     vocab: Vocabulary
     feature_dims: Dict[str, int]
+    # Externally-supplied per-caption consensus weights (video_id -> (N,)),
+    # e.g. from ``data.consensus_file`` — takes precedence over whatever
+    # the backend stores (reference: precomputed WXE consensus scores
+    # distributed separately from the label file).
+    _weight_override: Optional[Dict[str, np.ndarray]] = None
 
     def __len__(self) -> int:
         raise NotImplementedError
@@ -40,8 +45,21 @@ class CaptionDataset:
         """(num_captions, T+2) int32 encoded [BOS..EOS PAD...] rows."""
         raise NotImplementedError
 
+    def set_caption_weights(self, weights: Dict[str, np.ndarray]) -> None:
+        """Override consensus weights ({video_id: (num_captions,)})."""
+        self._weight_override = {
+            k: np.asarray(v, np.float32) for k, v in weights.items()
+        }
+
     def caption_weights(self, idx: int) -> np.ndarray:
         """(num_captions,) float32 consensus weights (ones when absent)."""
+        if self._weight_override is not None:
+            w = self._weight_override.get(self.video_id(idx))
+            if w is not None:
+                return w
+        return self._stored_caption_weights(idx)
+
+    def _stored_caption_weights(self, idx: int) -> np.ndarray:
         return np.ones((self.captions(idx).shape[0],), np.float32)
 
     def category(self, idx: int) -> int:
@@ -90,9 +108,9 @@ class InMemoryDataset(CaptionDataset):
     def captions(self, idx: int) -> np.ndarray:
         return self._caps[idx]
 
-    def caption_weights(self, idx: int) -> np.ndarray:
+    def _stored_caption_weights(self, idx: int) -> np.ndarray:
         if self._weights is None:
-            return super().caption_weights(idx)
+            return super()._stored_caption_weights(idx)
         return self._weights[idx]
 
     def category(self, idx: int) -> int:
@@ -119,8 +137,12 @@ class H5Dataset(CaptionDataset):
                  vocab: Vocabulary):
         import h5py  # deferred: h5 path only
 
+        from cst_captioning_tpu.data.packed import (
+            PackedSource,
+            is_packed_dir,
+        )
+
         self.vocab = vocab
-        self._h5 = {m: h5py.File(p, "r") for m, p in feature_files.items()}
         self._lab = h5py.File(label_file, "r")
         self._ids = [
             v.decode() if isinstance(v, bytes) else str(v)
@@ -128,9 +150,34 @@ class H5Dataset(CaptionDataset):
         ]
         self._start = self._lab["cap_start"][()]
         self._end = self._lab["cap_end"][()]
+        # Each modality is either a per-video h5 (reference layout) or a
+        # packed contiguous directory (data/packed.py streaming layout).
+        self._h5 = {}
+        self._packed = {}
+        self._packed_remap = {}
+        for m, p in feature_files.items():
+            if is_packed_dir(p):
+                src = PackedSource(p, m)
+                order = {v: i for i, v in enumerate(src.video_ids)}
+                missing = [v for v in self._ids if v not in order]
+                if missing:
+                    raise ValueError(
+                        f"packed modality {m!r} at {p} is missing "
+                        f"{len(missing)} of this split's videos "
+                        f"(first: {missing[:3]})"
+                    )
+                self._packed[m] = src
+                self._packed_remap[m] = np.asarray(
+                    [order[v] for v in self._ids], np.int64
+                )
+            else:
+                self._h5[m] = h5py.File(p, "r")
         self.feature_dims = {
             m: int(f[self._ids[0]].shape[-1]) for m, f in self._h5.items()
         }
+        self.feature_dims.update(
+            {m: src.dim for m, src in self._packed.items()}
+        )
 
     def __len__(self) -> int:
         return len(self._ids)
@@ -140,16 +187,37 @@ class H5Dataset(CaptionDataset):
 
     def features(self, idx: int) -> Dict[str, np.ndarray]:
         vid = self._ids[idx]
-        return {m: f[vid][()].astype(np.float32) for m, f in self._h5.items()}
+        out = {m: f[vid][()].astype(np.float32) for m, f in self._h5.items()}
+        for m, src in self._packed.items():
+            out[m] = src.get(int(self._packed_remap[m][idx]))
+        return out
+
+    def features_batch(self, idxs: np.ndarray, max_frames: int):
+        """Vectorized batch gather — available when EVERY modality is
+        packed; returns (feats {m: (B,F,D)}, masks {m: (B,F)}) or None
+        (the loader then falls back to per-video reads)."""
+        if self._h5 or not self._packed:
+            return None
+        if any(src.frames != max_frames for src in self._packed.values()):
+            # Packed at a different frame count: the fast gather would
+            # change the temporal subsample — use the per-video path
+            # (PackedSource.get + subsample_frames), which stays exact.
+            return None
+        feats, masks = {}, {}
+        for m, src in self._packed.items():
+            feats[m], masks[m] = src.get_batch(
+                self._packed_remap[m][np.asarray(idxs)], max_frames
+            )
+        return feats, masks
 
     def captions(self, idx: int) -> np.ndarray:
         return self._lab["captions"][self._start[idx] : self._end[idx]].astype(
             np.int32
         )
 
-    def caption_weights(self, idx: int) -> np.ndarray:
+    def _stored_caption_weights(self, idx: int) -> np.ndarray:
         if "weights" not in self._lab:
-            return super().caption_weights(idx)
+            return super()._stored_caption_weights(idx)
         return self._lab["weights"][self._start[idx] : self._end[idx]].astype(
             np.float32
         )

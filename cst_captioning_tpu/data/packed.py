@@ -1,0 +1,171 @@
+"""Packed contiguous feature storage — the streaming input path.
+
+Reference equivalent (SURVEY.md §2 "Data loading" / §3 hot loop #3): the
+reference reads one h5 dataset per video per step — fine for a 2017-era
+GPU, but random small reads are the classic host-side bottleneck feeding
+a TPU.  This module replaces them with one contiguous array per modality:
+
+* layout: ``<dir>/<modality>.npy`` shaped (V, F, D) — every video already
+  uniformly subsampled/zero-padded to F frames at pack time — plus
+  ``<dir>/meta.json`` ({"modality", "num_videos", "frames", "dim",
+  "dtype", "frame_counts", "video_ids"}).
+* reads are ``np.memmap`` fancy-indexed gathers: assembling a (B, F, D)
+  batch is ONE vectorized copy out of the OS page cache instead of B
+  h5 dataset lookups; a whole epoch streams the file sequentially.
+* ``dtype="float16"`` halves the bytes on disk and in flight (features
+  feed a bfloat16 matmul, so half precision storage costs nothing).
+
+``H5Dataset`` accepts a packed directory anywhere a feature h5 path is
+expected (``data.feature_files``), and ``BatchIterator`` uses the batched
+gather automatically when every modality is packed
+(``H5Dataset.features_batch``).  ``tools/pack_features.py`` converts
+per-video h5s; :func:`pack_dataset` packs any ``CaptionDataset`` (used by
+tests/benchmarks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+
+def _meta_path(directory: str, modality: str) -> str:
+    return os.path.join(directory, f"{modality}.meta.json")
+
+
+def _arr_path(directory: str, modality: str) -> str:
+    return os.path.join(directory, f"{modality}.npy")
+
+
+def pack_modality(
+    directory: str,
+    modality: str,
+    video_ids: List[str],
+    frames_iter,
+    max_frames: int,
+    dim: int,
+    dtype: str = "float32",
+) -> str:
+    """Write one modality's packed array.
+
+    ``frames_iter`` yields one (F_i, D) array per video in ``video_ids``
+    order; each is uniformly subsampled / zero-padded to ``max_frames``.
+    Streams straight into the memmap — peak memory is one video.
+    """
+    from cst_captioning_tpu.data.loader import subsample_frames
+
+    os.makedirs(directory, exist_ok=True)
+    path = _arr_path(directory, modality)
+    V = len(video_ids)
+    out = np.lib.format.open_memmap(
+        path, mode="w+", dtype=np.dtype(dtype), shape=(V, max_frames, dim)
+    )
+    counts = np.zeros((V,), np.int32)
+    for i, frames in enumerate(frames_iter):
+        fr = subsample_frames(np.asarray(frames), max_frames)
+        out[i, : fr.shape[0]] = fr
+        out[i, fr.shape[0] :] = 0
+        counts[i] = fr.shape[0]
+    out.flush()
+    del out
+    with open(_meta_path(directory, modality), "w") as f:
+        json.dump(
+            {
+                "modality": modality,
+                "num_videos": V,
+                "frames": max_frames,
+                "dim": dim,
+                "dtype": dtype,
+                "frame_counts": counts.tolist(),
+                "video_ids": video_ids,
+            },
+            f,
+        )
+    return path
+
+
+def pack_dataset(
+    ds,
+    directory: str,
+    max_frames: int,
+    modalities: Sequence[str] = (),
+    dtype: str = "float32",
+) -> Dict[str, str]:
+    """Pack every (or the named) modalities of a ``CaptionDataset``."""
+    modalities = list(modalities) or list(ds.feature_dims)
+    vids = [ds.video_id(i) for i in range(len(ds))]
+    paths = {}
+    for m in modalities:
+        paths[m] = pack_modality(
+            directory,
+            m,
+            vids,
+            (ds.features(i)[m] for i in range(len(ds))),
+            max_frames,
+            int(ds.feature_dims[m]),
+            dtype=dtype,
+        )
+    return paths
+
+
+class PackedSource:
+    """Reader for one packed modality (memmap-backed, shared across
+    iterators; reads hit the OS page cache)."""
+
+    def __init__(self, directory: str, modality: str):
+        with open(_meta_path(directory, modality)) as f:
+            self.meta = json.load(f)
+        self.modality = modality
+        self.frames = int(self.meta["frames"])
+        self.dim = int(self.meta["dim"])
+        self.frame_counts = np.asarray(self.meta["frame_counts"], np.int32)
+        self.video_ids = list(self.meta["video_ids"])
+        self._arr = np.load(_arr_path(directory, modality), mmap_mode="r")
+        assert self._arr.shape == (
+            len(self.video_ids),
+            self.frames,
+            self.dim,
+        ), self._arr.shape
+
+    def get(self, idx: int) -> np.ndarray:
+        """(F_i, D) float32 — trimmed to the video's true frame count
+        (CaptionDataset.features contract)."""
+        n = int(self.frame_counts[idx])
+        return np.asarray(self._arr[idx, :n], np.float32)
+
+    def get_batch(
+        self, idxs: np.ndarray, max_frames: int
+    ) -> Tuple[np.ndarray, np.ndarray]:
+        """One vectorized gather -> ((B, F, D) stored dtype, (B, F) mask).
+
+        Features keep the STORED dtype (float16 packs skip the f32
+        round-trip: the model casts to its compute dtype on device, and
+        half-precision host arrays also halve the H2D transfer).
+        Requires ``max_frames == packed frames``: a silent leading-frames
+        crop would diverge from the per-video path's uniform subsample —
+        pack at the training max_frames (the caller falls back to
+        per-video reads on mismatch).
+        """
+        if max_frames != self.frames:
+            raise ValueError(
+                f"loader max_frames={max_frames} != packed frames="
+                f"{self.frames} for modality {self.modality!r} — repack "
+                "at the training max_frames"
+            )
+        feats = self._arr[idxs]  # THE gather: one memcpy
+        counts = np.minimum(self.frame_counts[idxs], max_frames)
+        mask = (
+            np.arange(max_frames)[None, :] < counts[:, None]
+        ).astype(np.float32)
+        return feats, mask
+
+
+def is_packed_dir(path: str) -> bool:
+    """Heuristic used by ``H5Dataset``: a directory containing at least
+    one ``*.meta.json`` packed-modality pair."""
+    if not os.path.isdir(path):
+        return False
+    return any(n.endswith(".meta.json") for n in os.listdir(path))

@@ -73,15 +73,18 @@ class Vocabulary:
         return ids
 
     def decode(self, ids: Sequence[int]) -> str:
-        """ids -> sentence, stopping at PAD/EOS, skipping BOS."""
+        """ids -> sentence, stopping at PAD/EOS, skipping BOS.  Ids beyond
+        the table (model.vocab_size padded above len(vocab) for TP-friendly
+        shapes) decode as <unk> instead of crashing."""
         words = []
+        n = len(self.idx_to_word)
         for i in ids:
             i = int(i)
             if i in (PAD_ID, EOS_ID):
                 break
             if i == BOS_ID:
                 continue
-            words.append(self.idx_to_word[i])
+            words.append(self.idx_to_word[i] if 0 <= i < n else "<unk>")
         return " ".join(words)
 
     # ------------------------------------------------------------------ io

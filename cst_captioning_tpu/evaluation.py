@@ -67,11 +67,47 @@ def decode_dataset(
     return preds
 
 
+def load_cocofmt_gt(path: str) -> Dict[str, list]:
+    """cocofmt ground-truth json ({"annotations": [{"image_id",
+    "caption"}]}, the reference's coco-caption GT files) -> {vid: [refs]}."""
+    with open(path) as f:
+        raw = json.load(f)
+    gts: Dict[str, list] = {}
+    # Keyed off annotations only: an "images" entry with zero annotations
+    # must NOT yield an empty reference list (metrics crash on refs=[]).
+    for ann in raw["annotations"]:
+        gts.setdefault(str(ann["image_id"]), []).append(ann["caption"])
+    return gts
+
+
 def score_predictions(
-    ds: CaptionDataset, preds: Dict[str, str], metrics
+    ds: CaptionDataset,
+    preds: Dict[str, str],
+    metrics,
+    gts: Optional[Dict[str, list]] = None,
 ) -> Dict[str, float]:
-    """Assemble gts/res from the dataset's references and run the suite."""
-    gts = {ds.video_id(i): ds.references(i) for i in range(len(ds))}
+    """Run the metric suite; ground truth comes from ``gts`` (e.g. a
+    cocofmt file via ``data.cocofmt_files``) or the dataset's references."""
+    if gts is None:
+        gts = {ds.video_id(i): ds.references(i) for i in range(len(ds))}
+    else:
+        # Score only the decoded videos (the cocofmt file may cover more).
+        matched = {vid: gts[vid] for vid in preds if vid in gts}
+        if not matched:
+            raise ValueError(
+                "no overlap between predicted video ids and the cocofmt "
+                f"ground truth (e.g. pred {next(iter(preds), '?')!r} vs gt "
+                f"{next(iter(gts), '?')!r}) — id scheme mismatch?"
+            )
+        if len(matched) < len(preds):
+            import logging
+
+            logging.getLogger("cst_captioning_tpu.eval").warning(
+                "cocofmt ground truth covers %d/%d predicted videos — "
+                "scoring the covered subset only",
+                len(matched), len(preds),
+            )
+        gts = matched
     res = {vid: [preds[vid]] for vid in gts}
     return language_eval(gts, res, metrics=metrics)
 
@@ -111,7 +147,11 @@ def evaluate_dataset(
     eval artifacts.
     """
     preds = beam_decode_dataset(model, params, ds, cfg)
-    scores = score_predictions(ds, preds, cfg.eval.metrics)
+    cocofmt = cfg.data.cocofmt_files.get(cfg.eval.eval_split, "")
+    scores = score_predictions(
+        ds, preds, cfg.eval.metrics,
+        gts=load_cocofmt_gt(cocofmt) if cocofmt else None,
+    )
     if out_dir:
         os.makedirs(out_dir, exist_ok=True)
         with open(os.path.join(out_dir, "predictions.json"), "w") as f:

@@ -121,6 +121,8 @@ def ciderd_score_vec(
     to its consensus score).  They are normalized to sum 1 here; ``None``
     is the uniform 1/N mean.
     """
+    if not ref_vecs:  # no references registered: reward 0, not div-by-zero
+        return 0.0
     vec, norm, length = _counts2vec(ctest, doc_freq, log_ref_len)
     score = np.zeros(NGRAMS)
     if ref_weights is None:
@@ -189,7 +191,9 @@ class _CiderBase:
         ctests = [precook(res[k][0].split()) for k in keys]
         if self.df_mode == "corpus" and self._df is None:
             doc_freq = compute_doc_freq(crefs)
-            log_ref_len = math.log(float(len(crefs)))
+            # max(N, 2): matches CiderDRewarder and avoids the degenerate
+            # log(1)=0 idf scale on a 1-video corpus.
+            log_ref_len = math.log(max(float(len(crefs)), 2.0))
         else:
             doc_freq, log_ref_len = self._df, self._log_ref_len
         scores = np.array([

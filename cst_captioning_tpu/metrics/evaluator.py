@@ -68,6 +68,10 @@ def language_eval(
         out["ROUGE_L"], _ = Rouge().compute_score(gts, res)
     if "METEOR" in metrics:
         out["METEOR"], _ = get_meteor().compute_score(gts, res)
+        # Record WHICH backend scored (java jar vs pure-Python lite) — a
+        # scores.json is otherwise silent about the absolute-value shift
+        # between them (SURVEY.md §7 hard part #3).
+        out["METEOR_backend"] = meteor_backend_name()
     if "CIDEr" in metrics:
         out["CIDEr"], _ = Cider(df_mode=cider_df).compute_score(gts, res)
     if "CIDEr-D" in metrics or include_ciderd:

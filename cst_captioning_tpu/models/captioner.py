@@ -99,6 +99,14 @@ class CaptionModel(nn.Module):
     param_dtype: str = "float32"
     use_pallas: bool = False  # fused LSTM recurrence kernel fast path
     remat: bool = False       # rematerialize the decoder scan body
+    # Frame/sequence parallelism (parallel/ring.py): shard the concatenated
+    # frame axis of attention fusion over ``frame_axis`` of ``frame_mesh``;
+    # each decode step does local scoring + one psum instead of holding
+    # every frame on every device.  Exact vs dense (tests/test_ring.py).
+    shard_frames: bool = False
+    frame_mesh: Optional[object] = None     # jax.sharding.Mesh (static)
+    frame_axis: str = "model"
+    frame_batch_axis: Optional[str] = None  # compose with DP batch axis
 
     # ---------------------------------------------------------------- setup
     def setup(self):
@@ -212,6 +220,35 @@ class CaptionModel(nn.Module):
             return cache.ctx_static
         cdt = jnp.dtype(self.compute_dtype)
         q = h_top.astype(cdt) @ self.att_wh.astype(cdt)  # (B, A)
+        mesh = self.frame_mesh
+        if (
+            self.shard_frames
+            and mesh is not None
+            # Dense fallback when the concatenated frame axis doesn't
+            # divide the mesh axis (shard_map needs even splits).
+            and cache.att_vals.shape[1] % mesh.shape[self.frame_axis] == 0
+        ):
+            from cst_captioning_tpu.parallel.ring import (
+                sharded_context_attention,
+            )
+
+            batch_axis = self.frame_batch_axis
+            if (
+                batch_axis is not None
+                and q.shape[0] % mesh.shape[batch_axis] != 0
+            ):
+                # e.g. param-init traces with a single example row.
+                batch_axis = None
+            return sharded_context_attention(
+                q,
+                cache.att_vals,
+                cache.att_proj,
+                cache.att_mask,
+                self.att_v.astype(cdt),
+                mesh,
+                axis=self.frame_axis,
+                batch_axis=batch_axis,
+            )
         s = jnp.tanh(cache.att_proj + q[:, None, :]) @ self.att_v.astype(cdt)
         s = s[..., 0].astype(jnp.float32)  # (B, F)
         s = jnp.where(cache.att_mask > 0, s, -1e30)
@@ -468,15 +505,39 @@ class CaptionModel(nn.Module):
         )
 
 
-def model_from_config(cfg) -> CaptionModel:
-    """Build a CaptionModel from a ``Config`` (see ``config.py``)."""
+def model_from_config(cfg, mesh=None) -> CaptionModel:
+    """Build a CaptionModel from a ``Config`` (see ``config.py``).
+
+    ``mesh`` enables frame sharding when ``model.shard_frames`` is set:
+    the frame axis shards over the mesh's "model" axis, composing with the
+    "data" batch axis when present.
+    """
     m, d = cfg.model, cfg.data
     if m.feature_fusion not in ("meanpool", "attention"):
         raise ValueError(
             f"unknown feature_fusion {m.feature_fusion!r}; "
             "expected 'meanpool' or 'attention'"
         )
+    shard_frames = bool(getattr(m, "shard_frames", False)) and mesh is not None
+    if shard_frames and m.feature_fusion != "attention":
+        raise ValueError(
+            "model.shard_frames requires feature_fusion='attention' "
+            "(meanpool has no per-step frame attention to shard)"
+        )
+    if shard_frames and "model" not in mesh.shape:
+        raise ValueError(
+            "model.shard_frames shards frames over the mesh 'model' axis, "
+            f"but the mesh has axes {tuple(mesh.shape)} — add a model axis "
+            "to train.mesh_shape"
+        )
+    batch_axis = (
+        "data" if mesh is not None and mesh.shape.get("data", 1) > 1 else None
+    )
     return CaptionModel(
+        shard_frames=shard_frames,
+        frame_mesh=mesh if shard_frames else None,
+        frame_axis="model",
+        frame_batch_axis=batch_axis if shard_frames else None,
         vocab_size=m.vocab_size,
         rnn_size=m.rnn_size,
         num_layers=m.num_layers,

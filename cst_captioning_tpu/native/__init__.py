@@ -79,6 +79,12 @@ def _load() -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int),
         ctypes.c_int,
     ]
+    lib.ciderd_set_video_weights.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float),
+        ctypes.c_int,
+    ]
     lib.ciderd_finalize.argtypes = [ctypes.c_void_p]
     lib.ciderd_set_df.argtypes = [
         ctypes.c_void_p,
@@ -113,6 +119,8 @@ class NativeCiderD:
     ``refs_per_video``: list (dataset order) of lists of id sequences
     (word ids only — no BOS/EOS/PAD).  ``df`` optional {ngram tuple: raw
     df} with ``log_ref_len`` for idf-table mode; corpus mode otherwise.
+    ``ref_weights``: optional per-video (num_refs,) consensus weights
+    (None entries = uniform) — the paper's weighted consensus reward.
     """
 
     def __init__(
@@ -121,6 +129,7 @@ class NativeCiderD:
         df=None,
         log_ref_len: Optional[float] = None,
         vocab_size: Optional[int] = None,
+        ref_weights: Optional[List[Optional[np.ndarray]]] = None,
     ):
         # The packing bound must hold for anything a CANDIDATE can contain
         # (sampled rollouts range over the whole vocab), not just the refs.
@@ -129,10 +138,17 @@ class NativeCiderD:
                 f"vocab_size {vocab_size} exceeds the native packing bound "
                 f"({MAX_TOKEN_ID})"
             )
+        if ref_weights is not None and len(ref_weights) != len(
+            refs_per_video
+        ):
+            raise ValueError(
+                f"ref_weights has {len(ref_weights)} entries for "
+                f"{len(refs_per_video)} videos"
+            )
         lib = _load()
         self._lib = lib
         self._handle = ctypes.c_void_p(lib.ciderd_new())
-        for refs in refs_per_video:
+        for i, refs in enumerate(refs_per_video):
             for r in refs:
                 if any(t >= MAX_TOKEN_ID for t in r):
                     raise NativeUnavailable(
@@ -148,6 +164,20 @@ class NativeCiderD:
             lib.ciderd_add_video(
                 self._handle, _int_ptr(flat), _int_ptr(lens), len(refs)
             )
+            w = None if ref_weights is None else ref_weights[i]
+            if w is not None:
+                w = np.ascontiguousarray(w, dtype=np.float32)
+                if w.shape != (len(refs),):
+                    raise ValueError(
+                        f"video {i}: {w.shape[0]} weights for "
+                        f"{len(refs)} references"
+                    )
+                lib.ciderd_set_video_weights(
+                    self._handle,
+                    i,
+                    w.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                    len(refs),
+                )
         if df is None:
             lib.ciderd_finalize(self._handle)
         else:

@@ -243,6 +243,10 @@ int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
     counts_to_vec(cnts, s->doc_freq, s->log_ref_len, &hyp);
     const Video& v = s->videos[video_idx[b]];
     const size_t nref = v.ref_vecs.size();
+    if (nref == 0) {  // reference-less video: reward 0, not NaN
+      out[b] = 0.0f;
+      continue;
+    }
     double total = 0.0;
     if (v.weights.size() == nref && nref > 0) {
       double wsum = 0.0;

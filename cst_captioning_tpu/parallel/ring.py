@@ -21,7 +21,9 @@ Two primitives, both exact (not approximations):
   per decode step instead of gathering all frames to every device.
 
 Both are tested for exactness against the dense computation on the
-8-device CPU mesh (tests/test_ring.py).
+8-device CPU mesh (tests/test_ring.py).  ``sharded_context_attention`` is
+integrated into the captioner behind ``model.shard_frames``
+(models/captioner.py ``_context``), composing with the DP batch axis.
 """
 
 from __future__ import annotations
@@ -127,7 +129,11 @@ def _ctx_body(query, vals, proj, mask, att_v, axis: str):
     s = s[..., 0].astype(jnp.float32)
     s = jnp.where(mask > 0, s, NEG_INF)
     m_loc = jnp.max(s, axis=-1)                              # (B,)
-    m = jax.lax.pmax(m_loc, axis)
+    # The softmax max-shift cancels in value AND gradient, so stopping
+    # gradients through it is exact.  stop_gradient goes INSIDE: pmax has
+    # no differentiation rule, and AD only skips it when every operand
+    # tangent is already zero (training differentiates this body).
+    m = jax.lax.pmax(jax.lax.stop_gradient(m_loc), axis)
     e = jnp.where(mask > 0, jnp.exp(s - m[:, None]), 0.0)
     l = jax.lax.psum(e.sum(-1), axis)                        # (B,)
     ctx = jax.lax.psum(
@@ -144,24 +150,27 @@ def sharded_context_attention(
     att_v: jax.Array,
     mesh: Mesh,
     axis: str = "model",
+    batch_axis: Optional[str] = None,
 ) -> jax.Array:
     """Frame-sharded Bahdanau context attention (the captioner's per-step
     fusion, SURVEY.md §2 "Caption model"), exact vs the dense version.
 
-    query (B, A) — projected decoder state (replicated);
+    query (B, A) — projected decoder state (replicated over ``axis``);
     att_vals (B, F, E) / att_proj (B, F, A) / att_mask (B, F) — sharded
     along F over ``axis``;  att_v (A, 1) — the scoring vector.
+    ``batch_axis`` additionally shards B (data parallelism composes with
+    the frame sharding instead of being gathered away).
     """
     fn = jax.shard_map(
         functools.partial(_ctx_body, axis=axis),
         mesh=mesh,
         in_specs=(
-            P(),
-            P(None, axis, None),
-            P(None, axis, None),
-            P(None, axis),
+            P(batch_axis, None),
+            P(batch_axis, axis, None),
+            P(batch_axis, axis, None),
+            P(batch_axis, axis),
             P(),
         ),
-        out_specs=P(),
+        out_specs=P(batch_axis, None),
     )
     return fn(query, att_vals, att_proj, att_mask, att_v)

@@ -217,10 +217,17 @@ def prepare(
         refs = {vid: captions[vid] for vid in vids}
         lab = os.path.join(out_dir, f"labels_{split}.h5")
         coco = os.path.join(out_dir, f"cocofmt_{split}.json")
+        cons = os.path.join(out_dir, f"consensus_{split}.json")
         write_label_h5(lab, list(vids), encoded, weights, refs, categories)
         write_cocofmt(coco, list(vids), refs)
+        # Standalone consensus-weight artifact (reference: precomputed WXE
+        # CIDEr scores distributed separately) — consumable via
+        # ``data.consensus_file`` without re-reading the label h5.
+        with open(cons, "w") as f:
+            json.dump({v: weights[v].tolist() for v in vids}, f)
         paths[f"labels_{split}"] = lab
         paths[f"cocofmt_{split}"] = coco
+        paths[f"consensus_{split}"] = cons
     return paths
 
 

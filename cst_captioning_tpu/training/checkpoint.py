@@ -18,19 +18,62 @@ from typing import Any, Dict, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import orbax.checkpoint as ocp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
 def _abs(path: str) -> str:
     return os.path.abspath(path)
 
 
+def _replicated_sharding() -> NamedSharding:
+    """Fully-replicated sharding over ALL devices (every process)."""
+    mesh = Mesh(np.asarray(jax.devices()).reshape(-1), ("_all",))
+    return NamedSharding(mesh, P())
+
+
+def _is_host_local(x) -> bool:
+    """True for arrays orbax cannot serialize in a multi-process run:
+    plain host values, or jax.Arrays living only on this process's
+    devices (e.g. an un-meshed ``state.step`` counter)."""
+    if not isinstance(x, jax.Array):
+        return True
+    return jax.process_count() > 1 and x.sharding.is_fully_addressable
+
+
+def _globalize(tree):
+    """Multi-host save support: lift host-local leaves to globally
+    replicated arrays (the value is identical on every process — step
+    counters, un-meshed scalars).  Single-process: identity."""
+    if jax.process_count() == 1:
+        return tree
+    rep = _replicated_sharding()
+
+    def fix(x):
+        if not _is_host_local(x):
+            return x
+        arr = np.asarray(x)
+        return jax.make_array_from_callback(
+            arr.shape, rep, lambda idx, a=arr: a[idx]
+        )
+
+    return jax.tree.map(fix, tree)
+
+
 def _abstract(x):
     """Shape/dtype struct carrying the template's sharding, so restored
     arrays land exactly where the live state's arrays are (mesh-sharded
-    params, replicated opt counters, ...)."""
-    if isinstance(x, jax.Array):
+    params, replicated opt counters, ...).  Host-local templates map to
+    the replicated global sharding on multi-process runs (matching
+    ``_globalize`` at save time)."""
+    if isinstance(x, jax.Array) and not _is_host_local(x):
         return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+    x = np.asarray(x)
+    if jax.process_count() > 1:
+        return jax.ShapeDtypeStruct(
+            x.shape, x.dtype, sharding=_replicated_sharding()
+        )
     x = jnp.asarray(x)
     return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
@@ -40,14 +83,21 @@ def save_checkpoint(path: str, state, extra: Optional[Dict[str, Any]] = None
     """Save a TrainState: params + (opt_state, step) + json sidecar."""
     path = _abs(path)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.join(path, "params"), state.params, force=True)
+    ckptr.save(
+        os.path.join(path, "params"), _globalize(state.params), force=True
+    )
     ckptr.save(
         os.path.join(path, "opt"),
-        {"opt_state": state.opt_state, "step": jnp.asarray(state.step)},
+        _globalize(
+            {"opt_state": state.opt_state, "step": jnp.asarray(state.step)}
+        ),
         force=True,
     )
     ckptr.wait_until_finished()
-    if extra is not None:
+    # Orbax coordinates the array writes across processes; the json
+    # sidecar has no such coordination — only rank 0 writes it, or
+    # multi-host runs on a shared filesystem race on the same file.
+    if extra is not None and jax.process_index() == 0:
         with open(os.path.join(path, "infos.json"), "w") as f:
             json.dump(extra, f, indent=2, default=str)
 
@@ -75,10 +125,15 @@ def restore_checkpoint(path: str, state):
             "step": _abstract(state.step),
         },
     )
+    step = opt["step"]
+    if isinstance(step, jax.Array) and not step.sharding.is_fully_addressable:
+        # Globally-replicated scalar (multi-host save): every process holds
+        # the same value in its local shard.
+        step = step.addressable_shards[0].data
     return state.replace(
         params=params,
         opt_state=opt["opt_state"],
-        step=int(opt["step"]),
+        step=int(np.asarray(step)),
     )
 
 

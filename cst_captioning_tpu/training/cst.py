@@ -128,8 +128,18 @@ def make_cst_train_step(model: CaptionModel, cfg, train_ds) -> Callable:
     dispatch): ``(state, feats, feat_masks, captions, weights, category,
     video_idx, rng, ss_prob) -> (state, metrics)``; ``captions`` /
     ``weights`` / ``ss_prob`` are unused (sampling-based regime)."""
+    if cfg.train.cst_use_gt:
+        # CST_GT_None: the "samples" are the GT captions weighted by their
+        # consensus scores — no rollout, mathematically the WXE regime
+        # (reference Makefile target CST_GT_None; SURVEY.md §3.2).
+        from cst_captioning_tpu.training.steps import make_xe_train_step
+
+        log.info("cst_use_gt: dispatching CST_GT_None to the WXE step")
+        return make_xe_train_step(model)
     rewarder = CiderDRewarder(
-        train_ds, df_mode=cfg.data.idf_file or "corpus"
+        train_ds,
+        df_mode=cfg.data.idf_file or "corpus",
+        weighted_refs=cfg.train.cst_weighted_reward,
     )
     if io_callback_supported():
         return _make_one_graph_step(model, cfg, rewarder)

@@ -63,6 +63,7 @@ class CiderDRewarder:
         df_mode: str = "corpus",
         use_d: bool = True,
         backend: str = "auto",
+        weighted_refs: bool = False,
     ):
         """``df_mode="corpus"``: document frequencies over this dataset's
         reference sets (the reference's train-corpus idf option);
@@ -74,6 +75,12 @@ class CiderDRewarder:
         and silently falls back to Python when g++/packing bounds don't
         allow it; "native" raises instead of falling back; "python" skips
         the native path.
+
+        ``weighted_refs``: weight each reference's CIDEr-D contribution by
+        the dataset's per-caption consensus weight (``caption_weights``) —
+        the paper's weighted consensus reward (driver config 4, "20-ref
+        weighted CIDEr").  Videos whose weight count doesn't match their
+        reference count fall back to the uniform mean.
         """
         self.vocab = dataset.vocab
         self.use_d = use_d
@@ -86,11 +93,30 @@ class CiderDRewarder:
         # pipeline so idf tables and eval tokenization agree).
         self._encoded_refs: List[List[List[int]]] = []
         self._cooked_refs = []
+        self._ref_weights: Optional[List[Optional[np.ndarray]]] = (
+            [] if weighted_refs else None
+        )
+        n_mismatch = 0
         for i in range(len(dataset)):
             refs = dataset.references(i)
             encoded = [encode_tokens(ptb_tokenize(r)) for r in refs]
             self._encoded_refs.append(encoded)
             self._cooked_refs.append([precook(e) for e in encoded])
+            if weighted_refs:
+                w = np.asarray(dataset.caption_weights(i), np.float32)
+                if w.shape[0] == len(refs):
+                    self._ref_weights.append(w)
+                else:
+                    self._ref_weights.append(None)
+                    n_mismatch += 1
+        if n_mismatch:
+            import logging
+
+            logging.getLogger("cst_captioning_tpu.rewards").warning(
+                "weighted_refs: %d/%d videos have a caption-weight count "
+                "that doesn't match their reference count — those score "
+                "with the uniform mean", n_mismatch, len(dataset),
+            )
 
         if df_mode == "corpus":
             self.doc_freq = compute_doc_freq(self._cooked_refs)
@@ -124,6 +150,7 @@ class CiderDRewarder:
                     df=self._df_external,
                     log_ref_len=self.log_ref_len,
                     vocab_size=len(self.vocab),
+                    ref_weights=self._ref_weights,
                 )
                 self.backend = "native"
             except Exception as e:
@@ -156,12 +183,18 @@ class CiderDRewarder:
             return self._native.score_ids(video_idx, token_ids)
         out = np.zeros((token_ids.shape[0],), np.float32)
         for b in range(token_ids.shape[0]):
+            vid = int(video_idx[b])
             cand = precook(ids_until_end(token_ids[b]))
             out[b] = ciderd_score_vec(
                 cand,
-                self._ref_vecs[int(video_idx[b])],
+                self._ref_vecs[vid],
                 self.doc_freq,
                 self.log_ref_len,
                 use_d=self.use_d,
+                ref_weights=(
+                    None
+                    if self._ref_weights is None
+                    else self._ref_weights[vid]
+                ),
             )
         return out

@@ -44,9 +44,11 @@ def scheduled_sampling_prob(cfg_model, epoch: int) -> float:
         return 0.0
     if epoch < cfg_model.scheduled_sampling_start:
         return 0.0
+    # frac = (epoch - start) // every: ss_prob stays 0 for the first
+    # `every` epochs after start (reference opts.py semantics).
     frac = (
         epoch - cfg_model.scheduled_sampling_start
-    ) // cfg_model.scheduled_sampling_increase_every + 1
+    ) // cfg_model.scheduled_sampling_increase_every
     return float(
         min(
             cfg_model.scheduled_sampling_increase_prob * frac,
@@ -86,24 +88,11 @@ class Trainer:
         )
         os.makedirs(self.workdir, exist_ok=True)
 
-        self.model = model_from_config(cfg)
-        self.train_iter = BatchIterator(
-            train_ds,
-            batch_size=cfg.data.batch_size,
-            seq_per_img=cfg.data.seq_per_img,
-            max_frames=cfg.data.max_frames,
-            shuffle=cfg.data.shuffle,
-            drop_last=cfg.data.drop_last,
-            seed=cfg.train.seed,
-            shard_id=shard_id,
-            num_shards=num_shards,
-        )
-        steps_per_epoch = max(1, self.train_iter.num_batches())
-        self.tx = make_optimizer(cfg.train, steps_per_epoch)
-
         # Device mesh (reference: .cuda()/DataParallel only).  A single
         # device degenerates to no mesh; otherwise params go on the mesh
         # per the TP rules and batches are sharded over the data axis.
+        # Built before the model: frame sharding (model.shard_frames)
+        # closes over the mesh.
         if len(jax.devices()) > 1:
             from cst_captioning_tpu.parallel import (
                 batch_sharding,
@@ -122,6 +111,21 @@ class Trainer:
         else:
             self.mesh = None
             self._batch_sharding = None
+
+        self.model = model_from_config(cfg, mesh=self.mesh)
+        self.train_iter = BatchIterator(
+            train_ds,
+            batch_size=cfg.data.batch_size,
+            seq_per_img=cfg.data.seq_per_img,
+            max_frames=cfg.data.max_frames,
+            shuffle=cfg.data.shuffle,
+            drop_last=cfg.data.drop_last,
+            seed=cfg.train.seed,
+            shard_id=shard_id,
+            num_shards=num_shards,
+        )
+        steps_per_epoch = max(1, self.train_iter.num_batches())
+        self.tx = make_optimizer(cfg.train, steps_per_epoch)
 
         # All training randomness is derived per (seed, epoch, step) via
         # fold_in — resume-from-checkpoint reproduces the exact stream an
@@ -292,11 +296,22 @@ class Trainer:
         )
 
     def evaluate(self, ds: Optional[CaptionDataset] = None) -> Dict[str, float]:
-        from cst_captioning_tpu.evaluation import score_predictions
+        from cst_captioning_tpu.evaluation import (
+            load_cocofmt_gt,
+            score_predictions,
+        )
 
+        is_val = ds is None or ds is self.val_ds
         ds = ds or self.val_ds
         assert ds is not None, "no validation dataset"
-        return score_predictions(ds, self.predict(ds), self.cfg.eval.metrics)
+        # The configured GT json is the VAL split's — only applies when
+        # evaluating that split (an explicit other dataset scores against
+        # its own references).
+        cocofmt = self.cfg.data.cocofmt_files.get("val", "") if is_val else ""
+        return score_predictions(
+            ds, self.predict(ds), self.cfg.eval.metrics,
+            gts=load_cocofmt_gt(cocofmt) if cocofmt else None,
+        )
 
     # ----------------------------------------------------------------- fit
     def fit(self) -> Dict[str, dict]:
@@ -306,7 +321,13 @@ class Trainer:
             if self.val_ds is not None and (epoch + 1) % cfg.train.eval_every == 0:
                 val = self.evaluate()
                 entry["val"] = val
-                score = val.get("CIDEr", next(iter(val.values())))
+                score = val.get(
+                    "CIDEr",
+                    next(
+                        (v for v in val.values() if isinstance(v, float)),
+                        -np.inf,
+                    ),
+                )
                 if score > self.best_score:
                     self.best_score = score
                     self.best_epoch = epoch
@@ -320,7 +341,11 @@ class Trainer:
                     self._patience += 1
                 log.info(
                     "epoch %d val %s (best CIDEr %.4f @ %d)",
-                    epoch, {k: round(v, 4) for k, v in val.items()},
+                    epoch,
+                    {
+                        k: round(v, 4) if isinstance(v, float) else v
+                        for k, v in val.items()
+                    },
                     self.best_score, self.best_epoch,
                 )
             if (epoch + 1) % cfg.train.save_checkpoint_every == 0:
@@ -340,10 +365,14 @@ class Trainer:
                     },
                 )
             self.history[str(epoch)] = entry
-            with open(
-                os.path.join(self.workdir, cfg.train.history_file), "w"
-            ) as f:
-                json.dump(self.history, f, indent=2)
+            # Rank-0 guard: every process keeps the in-memory history (it
+            # feeds return values / resume), but only one writes the file
+            # on a shared filesystem.
+            if jax.process_index() == 0:
+                with open(
+                    os.path.join(self.workdir, cfg.train.history_file), "w"
+                ) as f:
+                    json.dump(self.history, f, indent=2)
             if (
                 self.val_ds is not None
                 and cfg.train.max_patience > 0

@@ -176,6 +176,57 @@ class TestCSTTraining:
         assert np.isfinite(e["reward"]) and e["reward"] >= 0.0
         assert "baseline" in e and "advantage" in e
 
+    def test_weighted_reward_end_to_end(self, corpus, tmp_path):
+        """Driver config 4 (CST_MS, 20-ref weighted CIDEr): the step runs
+        with cst_weighted_reward and reports a reward distinct from the
+        uniform-mean regime under identical seeds."""
+        ds, _ = corpus
+        rng = np.random.RandomState(17)
+        ds.set_caption_weights(
+            {
+                ds.video_id(i): rng.uniform(
+                    0.2, 2.0, size=len(ds.references(i))
+                ).astype(np.float32)
+                for i in range(len(ds))
+            }
+        )
+        try:
+            rewards = {}
+            for weighted in (False, True):
+                cfg = cst_cfg(tmp_path, "scb",
+                              cst_weighted_reward=weighted)
+                cfg.train.max_epochs = 1
+                t = Trainer(
+                    cfg, train_ds=ds, val_ds=None,
+                    workdir=str(tmp_path / f"wr_{weighted}"),
+                )
+                hist = t.fit()
+                assert np.isfinite(hist["0"]["reward"])
+                rewards[weighted] = hist["0"]["reward"]
+            assert rewards[True] != rewards[False]
+        finally:
+            ds._weight_override = None  # module-scoped fixture
+
+    def test_cst_use_gt_dispatches_to_wxe(self, corpus, tmp_path):
+        """CST_GT_None: train_mode=cst + cst_use_gt trains on the GT
+        captions via the weighted-XE step — same metrics as the wxe mode."""
+        ds, _ = corpus
+
+        def run(tag, **over):
+            cfg = cst_cfg(tmp_path, "none", **over)
+            cfg.train.max_epochs = 1
+            t = Trainer(cfg, train_ds=ds, val_ds=None,
+                        workdir=str(tmp_path / f"gt_{tag}"))
+            return t.fit()["0"]
+
+        e_gt = run("cst", cst_use_gt=True)
+        assert np.isfinite(e_gt["train_loss"])
+        assert "reward" not in e_gt  # XE-style metrics, no rollouts
+        e_wxe = run("wxe", train_mode="wxe")
+        np.testing.assert_allclose(
+            e_gt["train_loss"], e_wxe["train_loss"], rtol=1e-6
+        )
+
     def test_cst_improves_reward_after_warm_start(self, corpus, tmp_path):
         """The paper's staging: XE pretrain -> CST fine-tune; mean rollout
         reward must go up over CST epochs (SURVEY.md §4 'CST smoke')."""

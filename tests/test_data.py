@@ -35,6 +35,14 @@ class TestVocabulary:
         ids = vocab.encode(["dog"], max_len=3)
         assert ids[1] == UNK_ID
 
+    def test_decode_out_of_range_maps_to_unk(self):
+        """vocab_size is often padded above len(vocab) for TP-friendly
+        shapes; sampled ids beyond the table must decode as <unk>, not
+        crash validation decode."""
+        vocab = Vocabulary(["cat", "runs"])
+        ids = [BOS_ID, vocab.word_to_idx["cat"], len(vocab) + 7, EOS_ID]
+        assert vocab.decode(ids) == "cat <unk>"
+
     def test_min_freq_threshold(self):
         vocab = Vocabulary.build([["a", "a", "rare"]], min_freq=2)
         assert "a" in vocab and "rare" not in vocab
@@ -236,6 +244,52 @@ class TestPrepareAndH5:
             coco = json.load(f)
         assert {im["id"] for im in coco["images"]} == {"v4", "v5"}
         assert all("caption" in a for a in coco["annotations"])
+
+    def test_consensus_file_overrides_weights(self, raw, tmp_path):
+        """``data.consensus_file`` (json or flat npy) replaces the label
+        h5's stored WXE weights on the train split."""
+        from cst_captioning_tpu.config import get_preset
+        from cst_captioning_tpu.data.build import build_dataset
+
+        out = str(tmp_path / "out")
+        paths = prepare(raw, "msrvtt", out, min_freq=1, max_words=8)
+        # prepare() writes a standalone consensus artifact that matches
+        # the label h5's stored weights exactly.
+        with open(paths["consensus_train"]) as f:
+            cons = json.load(f)
+        vocab = Vocabulary.load(paths["vocab"])
+        ds = H5Dataset(paths["labels_train"], {}, vocab)
+        for i in range(len(ds)):
+            np.testing.assert_allclose(
+                cons[ds.video_id(i)], ds.caption_weights(i), rtol=1e-6
+            )
+
+        cfg = get_preset("msrvtt_resnet_c3d_xe")
+        cfg.data.label_file = os.path.join(out, "labels_{split}.h5")
+        cfg.data.vocab_file = paths["vocab"]
+        cfg.data.feature_files = {}
+
+        # json override: distinct constants per video
+        cpath = str(tmp_path / "cons.json")
+        with open(cpath, "w") as f:
+            json.dump(
+                {f"v{i}": [float(i + 1)] * 3 for i in range(4)}, f
+            )
+        cfg.data.consensus_file = cpath
+        ds2, _ = build_dataset(cfg, "train")
+        np.testing.assert_allclose(
+            ds2.caption_weights(2), [3.0, 3.0, 3.0]
+        )
+
+        # npy override: flat array aligned with caption rows
+        npy = str(tmp_path / "cons.npy")
+        np.save(npy, np.arange(12, dtype=np.float32))
+        cfg.data.consensus_file = npy
+        ds3, _ = build_dataset(cfg, "train")
+        np.testing.assert_allclose(ds3.caption_weights(1), [3, 4, 5])
+        # non-train splits keep stored weights
+        ds_t, _ = build_dataset(cfg, "test")
+        assert ds_t._weight_override is None
 
     def test_h5_dataset_with_features(self, raw, tmp_path):
         h5py = pytest.importorskip("h5py")

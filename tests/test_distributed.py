@@ -1,0 +1,111 @@
+"""Multi-host smoke test: two real CPU processes under jax.distributed.
+
+Covers SURVEY.md §5 "Distributed comm backend" beyond the in-process
+8-device simulation: cross-process batch assembly
+(``put_host_batch`` / ``make_array_from_process_local_data``), a psum
+over the global mesh, checkpoint save/restore with orbax's multi-process
+coordination, and the rank-0 guard on the json sidecar.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER = r"""
+import json
+import os
+import sys
+
+import numpy as np
+
+port, pid, tmp = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(
+    coordinator_address=f"127.0.0.1:{port}", num_processes=2, process_id=pid
+)
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 4, jax.device_count()  # 2 local x 2 procs
+
+import jax.numpy as jnp
+import optax
+from flax.training.train_state import TrainState
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from cst_captioning_tpu.parallel import make_mesh
+from cst_captioning_tpu.parallel.sharding import put_host_batch
+from cst_captioning_tpu.training import checkpoint as ckpt
+
+mesh = make_mesh({"data": 4, "model": 1})
+sh = NamedSharding(mesh, P("data"))
+
+# --- cross-process global batch assembly + collective ---------------------
+# Global batch = [0..7]; each process contributes its contiguous half.
+local = np.arange(4, dtype=np.float32) + 4.0 * pid
+g = put_host_batch(local, sh)
+assert g.shape == (8,)
+total = jax.jit(lambda x: jnp.sum(x))(g)
+assert float(total) == float(np.arange(8).sum()), float(total)
+
+# --- checkpoint save/restore with multi-process orbax ---------------------
+params = {"w": jax.device_put(jnp.ones((4, 2)), NamedSharding(mesh, P()))}
+state = TrainState.create(
+    apply_fn=lambda *a: None, params=params, tx=optax.sgd(0.1)
+)
+path = os.path.join(tmp, "ckpt")
+ckpt.save_checkpoint(path, state, extra={"epoch": 3, "rank": pid})
+from jax.experimental import multihost_utils
+
+multihost_utils.sync_global_devices("infos-written")  # rank 0 wrote sidecar
+# rank-0 guard: exactly one process wrote the sidecar, with ITS payload
+infos = ckpt.load_infos(path)
+assert infos["epoch"] == 3 and infos["rank"] == 0, infos
+
+state2 = state.replace(params={"w": params["w"] * 0.0})
+state2 = ckpt.restore_checkpoint(path, state2)
+np.testing.assert_allclose(np.asarray(state2.params["w"]), 1.0)
+
+print(f"worker {pid} ok")
+"""
+
+
+def test_two_process_distributed(tmp_path):
+    port = _free_port()
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", WORKER, str(port), str(pid), str(tmp_path)],
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=300)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"worker {pid} failed:\n{out[-3000:]}"
+        assert f"worker {pid} ok" in out
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

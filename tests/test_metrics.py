@@ -180,7 +180,81 @@ def test_meteor_stem_match():
     assert s_stem > s_miss
 
 
+class TestMeteorGolden:
+    """Hand-computed golden values pinning the METEOR-lite math
+    (alpha=0.85, gamma=0.6, frag_exp=3, stage weights 1.0/0.6/0.8)."""
+
+    def test_identity(self):
+        # 6 exact matches, 1 chunk: fmean=1, penalty=0.6*(1/6)^3.
+        m = MeteorLite()
+        s, _ = m.compute_score(
+            {"a": ["the cat sat on the mat"]},
+            {"a": ["the cat sat on the mat"]},
+        )
+        assert s == pytest.approx(1.0 - 0.6 * (1 / 6) ** 3, rel=1e-9)
+
+    def test_precision_recall_fmean(self):
+        # hyp "the cat" vs ref "the cat sat": P=1, R=2/3, m=2, ch=1.
+        p, r = 1.0, 2 / 3
+        fmean = p * r / (0.85 * p + 0.15 * r)
+        expect = fmean * (1 - 0.6 * 0.5**3)
+        s, _ = MeteorLite().compute_score(
+            {"a": ["the cat sat"]}, {"a": ["the cat"]}
+        )
+        assert s == pytest.approx(expect, rel=1e-9)
+
+    def test_stem_weight(self):
+        # "cats"~"cat" stem match w=0.6: wm=1.6, P=R=0.8, m=2, ch=1.
+        expect = 0.8 * (1 - 0.6 * 0.5**3)
+        s, _ = MeteorLite().compute_score(
+            {"a": ["the cat"]}, {"a": ["the cats"]}
+        )
+        assert s == pytest.approx(expect, rel=1e-9)
+
+    def test_fragmentation_penalty(self):
+        # "b a" vs "a b": 2 exact matches in 2 chunks: penalty=0.6*1^3.
+        s, _ = MeteorLite().compute_score({"a": ["a b"]}, {"a": ["b a"]})
+        assert s == pytest.approx(1.0 - 0.6, rel=1e-9)
+
+    def test_synonym_stage(self, tmp_path):
+        import json
+
+        path = tmp_path / "syn.json"
+        path.write_text(json.dumps({"feline": ["cat"]}))
+        m = MeteorLite(synonym_file=str(path))
+        # "a feline" vs "a cat": exact + synonym (w=0.8): wm=1.8,
+        # P=R=0.9, m=2, ch=1.
+        s, _ = m.compute_score({"a": ["a cat"]}, {"a": ["a feline"]})
+        assert s == pytest.approx(0.9 * (1 - 0.6 * 0.5**3), rel=1e-9)
+        # symmetric closure: the table entry works in either direction
+        s2, _ = m.compute_score({"a": ["a feline"]}, {"a": ["a cat"]})
+        assert s2 == pytest.approx(s, rel=1e-9)
+        # without the table the synonym token goes unmatched
+        s_no, _ = MeteorLite().compute_score(
+            {"a": ["a cat"]}, {"a": ["a feline"]}
+        )
+        assert s_no < s
+
+    def test_corpus_aggregation(self):
+        # Corpus score recomputes from summed statistics, not mean of
+        # per-segment scores (jar EVAL semantics).
+        m = MeteorLite()
+        gts = {"a": ["the cat"], "b": ["a dog runs"]}
+        res = {"a": ["the cat"], "b": ["a dog sleeps"]}
+        # seg a: wm=2, m=2, ch=1, lh=lr=2; seg b: wm=2, m=2, ch=1,
+        # lh=lr=3.  Aggregate: P=R=4/5, m=4, ch=2.
+        expect = 0.8 * (1 - 0.6 * 0.5**3)
+        s, seg = m.compute_score(gts, res)
+        assert s == pytest.approx(expect, rel=1e-9)
+        assert len(seg) == 2
+
+
 # -------------------------------------------------------------- evaluator
+
+def test_meteor_backend_stamped():
+    out = language_eval(GTS, RES_PARTIAL, metrics=["METEOR"])
+    assert out["METEOR_backend"] in ("java", "lite", "lite+syn")
+
 
 def test_language_eval_suite():
     out = language_eval(GTS, RES_PARTIAL)

@@ -89,6 +89,76 @@ class TestParity:
         assert s[0] > 1.0
 
 
+class TestWeightedConsensus:
+    """The paper's weighted consensus reward (driver config 4): each
+    reference's CIDEr-D contribution is weighted by its consensus score."""
+
+    @staticmethod
+    def weighted_ds(corpus, seed=11):
+        ds, vocab = corpus
+        rng = np.random.RandomState(seed)
+        ds.set_caption_weights(
+            {
+                ds.video_id(i): rng.uniform(
+                    0.2, 2.0, size=len(ds.references(i))
+                ).astype(np.float32)
+                for i in range(len(ds))
+            }
+        )
+        return ds, vocab
+
+    def test_native_matches_python_with_weights(self, corpus, built):
+        ds, vocab = self.weighted_ds(corpus)
+        py = CiderDRewarder(ds, backend="python", weighted_refs=True)
+        nat = CiderDRewarder(ds, backend="native", weighted_refs=True)
+        assert nat.backend == "native"
+        vidx, toks = random_candidates(ds, vocab, seed=2)
+        np.testing.assert_allclose(
+            nat.score_ids(vidx, toks),
+            py.score_ids(vidx, toks),
+            rtol=1e-5,
+            atol=1e-6,
+        )
+
+    def test_weighted_differs_from_uniform(self, corpus, built):
+        ds, vocab = self.weighted_ds(corpus)
+        uni = CiderDRewarder(ds, backend="python")
+        wtd = CiderDRewarder(ds, backend="python", weighted_refs=True)
+        # Candidate = each video's first reference: its similarity varies
+        # across the sibling refs, so re-weighting must shift the score.
+        L = ds.captions(0).shape[1]
+        cands = np.zeros((len(ds), L), np.int32)
+        for i in range(len(ds)):
+            cap = ds.captions(i)[0]
+            cands[i, : cap.shape[0] - 1] = cap[1:]
+        vidx = np.arange(len(ds), dtype=np.int32)
+        assert not np.allclose(
+            uni.score_ids(vidx, cands), wtd.score_ids(vidx, cands)
+        )
+
+    def test_uniform_weights_equal_unweighted(self, corpus, built):
+        ds, vocab = corpus
+        ds.set_caption_weights(
+            {
+                ds.video_id(i): np.full(
+                    len(ds.references(i)), 3.7, np.float32
+                )
+                for i in range(len(ds))
+            }
+        )
+        for backend in ("python", "native"):
+            base = CiderDRewarder(ds, backend=backend)
+            wtd = CiderDRewarder(ds, backend=backend, weighted_refs=True)
+            vidx, toks = random_candidates(ds, vocab, seed=3)
+            np.testing.assert_allclose(
+                wtd.score_ids(vidx, toks),
+                base.score_ids(vidx, toks),
+                rtol=1e-5,
+                atol=1e-6,
+            )
+        ds._weight_override = None  # un-poison the module-scoped corpus
+
+
 class TestGuards:
     def test_packing_bound_rejected(self, built):
         with pytest.raises(NativeUnavailable):
@@ -100,6 +170,20 @@ class TestGuards:
         toks = np.zeros((1, 5), np.int32)
         with pytest.raises(IndexError, match="out of range"):
             nat.score_ids(np.asarray([len(ds)], np.int32), toks)
+
+    def test_zero_reference_video_scores_zero(self, built):
+        """A programmatic video with no references must reward 0.0, not
+        NaN/inf (division by nref guard, both backends)."""
+        from cst_captioning_tpu.metrics.cider import (
+            ciderd_score_vec,
+            precook,
+        )
+
+        nat = NativeCiderD([[[5, 6, 7]], []])
+        toks = np.asarray([[5, 6, 7, 0, 0]], np.int32)
+        s = nat.score_ids(np.asarray([1], np.int32), toks)
+        assert s[0] == 0.0
+        assert ciderd_score_vec(precook([5, 6]), [], {}, 1.0) == 0.0
 
     def test_auto_backend_never_raises(self, corpus):
         ds, _ = corpus

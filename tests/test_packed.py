@@ -1,0 +1,196 @@
+"""Packed contiguous feature store: round-trip parity with per-video
+reads, loader fast-path equivalence, CLI converter, and an assembly
+throughput sanity check (SURVEY.md hot loop #3)."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.data import BatchIterator, make_synthetic_dataset
+from cst_captioning_tpu.data.packed import (
+    PackedSource,
+    is_packed_dir,
+    pack_dataset,
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return make_synthetic_dataset(
+        num_videos=20, feature_dims={"resnet": 32, "c3d": 16}, max_frames=6,
+        seed=9,
+    )
+
+
+class TestPackRoundTrip:
+    @pytest.mark.parametrize("dtype", ["float32", "float16"])
+    def test_get_matches_dataset(self, corpus, tmp_path, dtype):
+        ds, _ = corpus
+        d = str(tmp_path / f"packed_{dtype}")
+        pack_dataset(ds, d, max_frames=6, dtype=dtype)
+        assert is_packed_dir(d)
+        src = PackedSource(d, "resnet")
+        tol = 1e-6 if dtype == "float32" else 2e-3
+        for i in (0, 7, 19):
+            ref = ds.features(i)["resnet"]
+            got = src.get(i)
+            assert got.shape == ref.shape and got.dtype == np.float32
+            np.testing.assert_allclose(got, ref, rtol=tol, atol=tol)
+
+    def test_get_batch_gather_and_mask(self, corpus, tmp_path):
+        ds, _ = corpus
+        d = str(tmp_path / "packed")
+        pack_dataset(ds, d, max_frames=6)
+        src = PackedSource(d, "c3d")
+        idxs = np.asarray([3, 3, 11, 0])
+        feats, mask = src.get_batch(idxs, 6)
+        assert feats.shape == (4, 6, 16) and mask.shape == (4, 6)
+        for b, i in enumerate(idxs):
+            ref = ds.features(int(i))["c3d"]
+            n = ref.shape[0]
+            np.testing.assert_allclose(feats[b, :n], ref, rtol=1e-6)
+            assert mask[b].sum() == n and (feats[b, n:] == 0).all()
+
+    def test_max_frames_guard(self, corpus, tmp_path):
+        ds, _ = corpus
+        d = str(tmp_path / "packed")
+        pack_dataset(ds, d, max_frames=6)
+        for bad in (5, 7):  # any mismatch: no silent temporal crop
+            with pytest.raises(ValueError, match="packed frames"):
+                PackedSource(d, "resnet").get_batch(np.asarray([0]), bad)
+
+
+class TestLoaderFastPath:
+    def test_batches_identical_to_per_video(self, corpus, tmp_path):
+        """The packed gather must produce bit-identical batches to the
+        per-video read path under the same seed."""
+        from cst_captioning_tpu.data.datasets import H5Dataset
+        from cst_captioning_tpu.tools.prepare_data import prepare
+        import json
+
+        ds, _ = corpus
+        # Build an h5-backed split whose features come from the packed dir.
+        raw = {
+            "splits": {"train": [ds.video_id(i) for i in range(len(ds))]},
+            "captions": {
+                ds.video_id(i): ds.references(i) for i in range(len(ds))
+            },
+        }
+        ann = tmp_path / "ann.json"
+        ann.write_text(json.dumps(raw))
+        out = str(tmp_path / "prep")
+        paths = prepare(str(ann), "simple", out, max_words=10)
+        d = str(tmp_path / "packed")
+        pack_dataset(ds, d, max_frames=6)
+
+        from cst_captioning_tpu.data.vocab import Vocabulary
+
+        vocab = Vocabulary.load(paths["vocab"])
+        packed_ds = H5Dataset(
+            paths["labels_train"], {"resnet": d, "c3d": d}, vocab
+        )
+        assert packed_ds.feature_dims == {"resnet": 32, "c3d": 16}
+        assert packed_ds.features_batch(np.asarray([0, 1]), 6) is not None
+
+        def batches(dataset):
+            it = BatchIterator(
+                dataset, batch_size=4, seq_per_img=2, max_frames=6,
+                shuffle=True, seed=3,
+            )
+            return list(it.epoch(0))
+
+        # Per-video path: same dataset object with the fast path disabled.
+        got = batches(packed_ds)
+        plain = batches(ds)  # InMemory original (no features_batch)
+        # Same videos in the same shuffled order (same seed over same size)
+        for bg, bp in zip(got, plain):
+            order = [
+                [ds.video_id(i) for i in range(len(ds))].index(v)
+                for v in bg.video_ids
+            ]
+            np.testing.assert_array_equal(
+                np.asarray(order, np.int32), bp.video_idx
+            )
+            for m in ("resnet", "c3d"):
+                np.testing.assert_allclose(
+                    bg.feats[m], bp.feats[m], rtol=1e-6, atol=1e-6
+                )
+                np.testing.assert_array_equal(
+                    bg.feat_masks[m], bp.feat_masks[m]
+                )
+
+    def test_pack_features_cli(self, corpus, tmp_path):
+        import h5py
+        import json
+
+        from cst_captioning_tpu.tools.pack_features import main as pack_main
+        from cst_captioning_tpu.tools.prepare_data import prepare
+
+        ds, _ = corpus
+        raw = {
+            "splits": {"train": [ds.video_id(i) for i in range(len(ds))]},
+            "captions": {
+                ds.video_id(i): ds.references(i) for i in range(len(ds))
+            },
+        }
+        ann = tmp_path / "ann.json"
+        ann.write_text(json.dumps(raw))
+        paths = prepare(str(ann), "simple", str(tmp_path / "prep"),
+                        max_words=10)
+        feat_h5 = str(tmp_path / "resnet.h5")
+        with h5py.File(feat_h5, "w") as f:
+            for i in range(len(ds)):
+                f.create_dataset(
+                    ds.video_id(i), data=ds.features(i)["resnet"]
+                )
+        out = str(tmp_path / "packed_cli")
+        pack_main([
+            "--label-file", paths["labels_train"],
+            "--features", f"resnet={feat_h5}",
+            "--out-dir", out, "--max-frames", "6",
+        ])
+        src = PackedSource(out, "resnet")
+        np.testing.assert_allclose(
+            src.get(2), ds.features(2)["resnet"], rtol=1e-6
+        )
+
+
+class TestThroughput:
+    def test_packed_assembly_faster_than_per_video_h5(self, tmp_path):
+        """MSR-VTT-shaped (scaled-down) assembly race: the packed gather
+        must beat per-video h5 reads comfortably."""
+        import h5py
+
+        rng = np.random.RandomState(0)
+        V, F, D = 64, 28, 512
+        feats = rng.randn(V, F, D).astype(np.float32)
+        h5p = str(tmp_path / "f.h5")
+        with h5py.File(h5p, "w") as f:
+            for i in range(V):
+                f.create_dataset(f"v{i}", data=feats[i])
+        d = str(tmp_path / "packed")
+        from cst_captioning_tpu.data.packed import pack_modality
+
+        pack_modality(
+            d, "resnet", [f"v{i}" for i in range(V)],
+            (feats[i] for i in range(V)), F, D,
+        )
+        src = PackedSource(d, "resnet")
+        idxs = rng.permutation(V)[:32]
+
+        src.get_batch(idxs, F)  # warm page cache
+        t0 = time.perf_counter()
+        for _ in range(5):
+            src.get_batch(idxs, F)
+        t_packed = time.perf_counter() - t0
+
+        with h5py.File(h5p, "r") as f:
+            t0 = time.perf_counter()
+            for _ in range(5):
+                out = np.zeros((len(idxs), F, D), np.float32)
+                for b, i in enumerate(idxs):
+                    out[b] = f[f"v{i}"][()]
+            t_h5 = time.perf_counter() - t0
+        assert t_packed < t_h5, (t_packed, t_h5)

@@ -72,9 +72,13 @@ class TestSchedules:
         cfg.scheduled_sampling_max_prob = 0.25
         assert scheduled_sampling_prob(cfg, 0) == 0.0
         assert scheduled_sampling_prob(cfg, 1) == 0.0
-        assert scheduled_sampling_prob(cfg, 2) == pytest.approx(0.1)
-        assert scheduled_sampling_prob(cfg, 5) == pytest.approx(0.2)
-        assert scheduled_sampling_prob(cfg, 11) == pytest.approx(0.25)
+        # Reference opts.py semantics: frac = (epoch - start) // every, so
+        # ss stays 0 for the first `every` epochs after the start epoch.
+        assert scheduled_sampling_prob(cfg, 2) == 0.0
+        assert scheduled_sampling_prob(cfg, 4) == 0.0
+        assert scheduled_sampling_prob(cfg, 5) == pytest.approx(0.1)
+        assert scheduled_sampling_prob(cfg, 8) == pytest.approx(0.2)
+        assert scheduled_sampling_prob(cfg, 14) == pytest.approx(0.25)
         cfg.scheduled_sampling_start = -1
         assert scheduled_sampling_prob(cfg, 100) == 0.0
 
