@@ -65,6 +65,10 @@ class ModelConfig:
     param_dtype: str = "float32"
     compute_dtype: str = "bfloat16"   # MXU-friendly activations
     use_pallas_lstm: bool = False     # fused Pallas LSTM cell fast path
+    # Fused Pallas Bahdanau attention step (attention fusion only) —
+    # independent of the LSTM kernel; exact vs the dense math, falls back
+    # off-TPU / on untileable batches (ops/pallas_attention.py).
+    use_pallas_attention: bool = False
     # Shard the attention-fusion frame axis over the mesh "model" axis
     # (sequence/context parallelism for long feature streams; requires
     # feature_fusion="attention" and a multi-device mesh).

@@ -97,7 +97,8 @@ class CaptionModel(nn.Module):
     category_embed_size: int = 64
     compute_dtype: str = "bfloat16"
     param_dtype: str = "float32"
-    use_pallas: bool = False  # fused LSTM recurrence kernel fast path
+    use_pallas: bool = False      # fused LSTM recurrence kernel fast path
+    use_pallas_attention: bool = False  # fused Bahdanau attention step kernel
     remat: bool = False       # rematerialize the decoder scan body
     # Frame/sequence parallelism (parallel/ring.py): shard the concatenated
     # frame axis of attention fusion over ``frame_axis`` of ``frame_mesh``;
@@ -249,12 +250,21 @@ class CaptionModel(nn.Module):
                 axis=self.frame_axis,
                 batch_axis=batch_axis,
             )
-        s = jnp.tanh(cache.att_proj + q[:, None, :]) @ self.att_v.astype(cdt)
-        s = s[..., 0].astype(jnp.float32)  # (B, F)
-        s = jnp.where(cache.att_mask > 0, s, -1e30)
-        a = jax.nn.softmax(s, axis=-1)
-        ctx = jnp.einsum("bf,bfe->be", a.astype(cdt), cache.att_vals)
-        return ctx
+        from cst_captioning_tpu.ops.pallas_attention import (
+            fused_context_attention,
+        )
+
+        # One decode step of score -> masked softmax -> context; the
+        # Pallas path reads att_proj/att_vals from HBM once per step
+        # (ops/pallas_attention.py), the fallback is the dense XLA math.
+        return fused_context_attention(
+            q,
+            cache.att_proj,
+            cache.att_mask,
+            cache.att_vals,
+            self.att_v.astype(cdt),
+            use_pallas=self.use_pallas_attention,
+        )
 
     # ------------------------------------------------------------ step core
     def _step(
@@ -538,6 +548,7 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         frame_mesh=mesh if shard_frames else None,
         frame_axis="model",
         frame_batch_axis=batch_axis if shard_frames else None,
+        use_pallas_attention=getattr(m, "use_pallas_attention", False),
         vocab_size=m.vocab_size,
         rnn_size=m.rnn_size,
         num_layers=m.num_layers,

@@ -48,7 +48,7 @@ def build_ciderd(force: bool = False) -> str:
         # half-written .so.
         tmp = f"{_LIB}.{os.getpid()}.tmp"
         cmd = [
-            "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+            "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
             _SRC, "-o", tmp,
         ]
         try:

@@ -21,9 +21,11 @@
 //   (candidate n-gram, reference).
 // * C ABI for ctypes — no pybind11 in this environment.
 
+#include <algorithm>
 #include <cmath>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -47,6 +49,13 @@ struct Video {
   std::vector<RefVec> ref_vecs;         // cooked at finalize()
   std::vector<float> weights;           // per-ref consensus weights
                                         // (empty = uniform)
+  // Merged scoring structure: one hash lookup per CANDIDATE n-gram
+  // instead of one per (n-gram, reference).  merged[key][r] = ref r's
+  // tf-idf weight for that n-gram (0 when absent); norms/lengths are the
+  // per-ref per-order L2 norms and unigram lengths.
+  std::unordered_map<uint64_t, std::vector<float>> merged;
+  std::vector<double> ref_norms;        // nref * kNGrams
+  std::vector<long> ref_lengths;        // nref
 };
 
 struct Scorer {
@@ -95,25 +104,61 @@ void counts_to_vec(const Counts cnts[kNGrams],
   }
 }
 
-double sim_d(const RefVec& hyp, const RefVec& ref) {
-  const double delta = static_cast<double>(hyp.length - ref.length);
-  const double penalty = std::exp(-(delta * delta) / (2.0 * kSigma * kSigma));
-  double total = 0.0;
-  for (int n = 0; n < kNGrams; ++n) {
-    double val = 0.0;
-    for (const auto& kv : hyp.vec[n]) {
-      auto it = ref.vec[n].find(kv.first);
-      if (it != ref.vec[n].end()) {
-        val += static_cast<double>(std::min(kv.second, it->second)) *
-               static_cast<double>(it->second);
+// Build the merged per-video scoring structure from cooked ref_vecs and
+// release the per-ref maps (scoring never touches them again).
+void build_merged(Video* v) {
+  const size_t nref = v->ref_vecs.size();
+  v->merged.clear();
+  v->ref_norms.assign(nref * kNGrams, 0.0);
+  v->ref_lengths.assign(nref, 0);
+  for (size_t r = 0; r < nref; ++r) {
+    const RefVec& rv = v->ref_vecs[r];
+    v->ref_lengths[r] = rv.length;
+    for (int n = 0; n < kNGrams; ++n) {
+      v->ref_norms[r * kNGrams + n] = rv.norm[n];
+      for (const auto& kv : rv.vec[n]) {
+        auto& slot = v->merged[kv.first];
+        if (slot.empty()) slot.assign(nref, 0.0f);
+        slot[r] = kv.second;
       }
     }
-    if (hyp.norm[n] != 0.0 && ref.norm[n] != 0.0) {
-      val /= hyp.norm[n] * ref.norm[n];
-    }
-    total += val * penalty;
   }
-  return total;
+  v->ref_vecs.clear();
+  v->ref_vecs.shrink_to_fit();
+}
+
+// CIDEr-D of one cooked hypothesis against every reference of `v` at
+// once: one merged-map lookup per hypothesis n-gram, then per-ref
+// normalization + Gaussian length penalty.  out_sims[r] = sim_d(hyp, r).
+void sim_d_all(const RefVec& hyp, const Video& v, double* out_sims) {
+  const size_t nref = v.ref_lengths.size();
+  std::vector<double> acc(nref * kNGrams, 0.0);
+  for (int n = 0; n < kNGrams; ++n) {
+    for (const auto& kv : hyp.vec[n]) {
+      auto it = v.merged.find(kv.first);
+      if (it == v.merged.end()) continue;
+      const float* m = it->second.data();
+      const float wh = kv.second;
+      double* a = acc.data() + n;  // stride kNGrams per ref
+      for (size_t r = 0; r < nref; ++r) {
+        a[r * kNGrams] += static_cast<double>(std::min(wh, m[r])) *
+                          static_cast<double>(m[r]);
+      }
+    }
+  }
+  for (size_t r = 0; r < nref; ++r) {
+    const double delta = static_cast<double>(hyp.length - v.ref_lengths[r]);
+    const double penalty =
+        std::exp(-(delta * delta) / (2.0 * kSigma * kSigma));
+    double total = 0.0;
+    for (int n = 0; n < kNGrams; ++n) {
+      double val = acc[r * kNGrams + n];
+      const double nr = v.ref_norms[r * kNGrams + n];
+      if (hyp.norm[n] != 0.0 && nr != 0.0) val /= hyp.norm[n] * nr;
+      total += val * penalty;
+    }
+    out_sims[r] = total;
+  }
 }
 
 }  // namespace
@@ -173,6 +218,7 @@ void ciderd_finalize(void* h) {
       counts_to_vec(cnts, s->doc_freq, s->log_ref_len, &rv);
       v.ref_vecs.push_back(std::move(rv));
     }
+    build_merged(&v);
   }
   s->finalized = true;
 }
@@ -207,6 +253,7 @@ void ciderd_finalize_with_df(void* h, double log_ref_len) {
       counts_to_vec(cnts, s->doc_freq, s->log_ref_len, &rv);
       v.ref_vecs.push_back(std::move(rv));
     }
+    build_merged(&v);
   }
   s->finalized = true;
 }
@@ -220,14 +267,11 @@ int ciderd_num_videos(void* h) {
 // out (batch,) float32 CIDEr-D x10.
 // Returns 0 on success, -1 if any video_idx is out of range (the Python
 // wrapper raises IndexError — matching the Python scorer — instead of UB).
-int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
-                 int max_len, float* out) {
-  auto* s = static_cast<Scorer*>(h);
-  const int n = static_cast<int>(s->videos.size());
-  for (int b = 0; b < batch; ++b) {
-    if (video_idx[b] < 0 || video_idx[b] >= n) return -1;
-  }
-  for (int b = 0; b < batch; ++b) {
+namespace {
+
+void score_rows(const Scorer* s, const int* video_idx, const int* tokens,
+                int max_len, float* out, int begin, int end) {
+  for (int b = begin; b < end; ++b) {
     const int* row = tokens + static_cast<long>(b) * max_len;
     std::vector<int> cand;
     cand.reserve(max_len);
@@ -242,28 +286,63 @@ int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
     RefVec hyp;
     counts_to_vec(cnts, s->doc_freq, s->log_ref_len, &hyp);
     const Video& v = s->videos[video_idx[b]];
-    const size_t nref = v.ref_vecs.size();
+    const size_t nref = v.ref_lengths.size();
     if (nref == 0) {  // reference-less video: reward 0, not NaN
       out[b] = 0.0f;
       continue;
     }
+    std::vector<double> sims(nref);
+    sim_d_all(hyp, v, sims.data());
     double total = 0.0;
-    if (v.weights.size() == nref && nref > 0) {
+    if (v.weights.size() == nref) {
       double wsum = 0.0;
       for (float w : v.weights) wsum += w;
       const bool degenerate = wsum <= 1e-12;
       for (size_t r = 0; r < nref; ++r) {
         const double w =
             degenerate ? 1.0 / nref : v.weights[r] / wsum;
-        total += w * sim_d(hyp, v.ref_vecs[r]);
+        total += w * sims[r];
       }
       out[b] = static_cast<float>(total / kNGrams * 10.0);
     } else {
-      for (const auto& rv : v.ref_vecs) total += sim_d(hyp, rv);
+      for (size_t r = 0; r < nref; ++r) total += sims[r];
       out[b] = static_cast<float>(
           total / kNGrams / static_cast<double>(nref) * 10.0);
     }
   }
+}
+
+}  // namespace
+
+int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
+                 int max_len, float* out) {
+  auto* s = static_cast<Scorer*>(h);
+  const int n = static_cast<int>(s->videos.size());
+  for (int b = 0; b < batch; ++b) {
+    if (video_idx[b] < 0 || video_idx[b] >= n) return -1;
+  }
+  // Rows are independent over a read-only scorer — fan out across cores.
+  // A CST step scores B*S (e.g. 1280) rollouts; single-threaded this is
+  // the dominant host cost (SURVEY.md hard part #1).  Threads are
+  // spawned per call (~0.3 ms for 16) — noise against the >=64-rows-per-
+  // worker scoring time that gates spawning; small batches stay inline.
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int workers = std::max(1, std::min({hw, batch / 64, 16}));
+  if (workers <= 1) {
+    score_rows(s, video_idx, tokens, max_len, out, 0, batch);
+    return 0;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  const int chunk = (batch + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    const int begin = w * chunk;
+    const int end = std::min(batch, begin + chunk);
+    if (begin >= end) break;
+    pool.emplace_back(score_rows, s, video_idx, tokens, max_len, out,
+                      begin, end);
+  }
+  for (auto& t : pool) t.join();
   return 0;
 }
 

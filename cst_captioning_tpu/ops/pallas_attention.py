@@ -1,0 +1,213 @@
+"""Fused Bahdanau attention step as a Pallas TPU kernel.
+
+The attention-fusion captioner (reference ``model.py`` temporal soft
+attention, SURVEY.md §2 "Caption model") recomputes, at EVERY decode
+step, ``softmax(tanh(att_proj + q) @ v) @ att_vals`` over all frames.
+Under XLA this materializes the (B, F, A) tanh activation and re-reads
+``att_proj``/``att_vals`` from HBM several times per step — measured at
+~2x total step time versus mean-pool fusion on MSR-VTT shapes (see
+``docs/PERF.md``).  This kernel computes score -> masked softmax ->
+context in ONE VMEM pass per batch tile: each of ``att_proj`` and
+``att_vals`` is read from HBM exactly once per step, and the tanh
+activation never leaves VMEM.
+
+Autodiff: ``fused_context_attention`` carries a ``jax.custom_vjp`` whose
+backward is a second single-pass kernel — it recomputes the (cheap) tanh
+from the inputs, reuses the saved softmax weights, and emits every
+cotangent (d_proj, d_q, d_vals, d_v) in one pass; d_v accumulates across
+batch tiles through a shared output block (TPU grid steps run
+sequentially).
+
+Numerics match ``CaptionModel._context``'s dense path: tanh/matmuls in
+the compute dtype, score/softmax in float32, masked positions at -1e30.
+Shapes: q (B, A); att_proj (B, F, A); att_mask (B, F); att_vals
+(B, F, E); att_v (A, 1) -> context (B, E).  Falls back to dense XLA when
+the batch can't tile (B < 8 or not a multiple of 8) or when not on a TPU
+backend (interpret mode covers CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def dense_context_attention(q, att_proj, att_mask, att_vals, att_v):
+    """Reference XLA path — identical math to CaptionModel's inline
+    version (kept here so kernel tests diff against one definition)."""
+    s = jnp.tanh(att_proj + q[:, None, :]) @ att_v
+    s = s[..., 0].astype(jnp.float32)
+    s = jnp.where(att_mask > 0, s, NEG_INF)
+    a = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bf,bfe->be", a.astype(att_vals.dtype), att_vals)
+
+
+def _pick_bt(B: int, cap: int = 32) -> Optional[int]:
+    """Largest batch tile <= cap that is a multiple of 8, divides B, and
+    keeps the (bt, F, A) blocks a few MB.  None -> dense fallback.  The
+    backward kernel uses a smaller cap: it holds ~2x the forward's live
+    blocks (recomputed tanh + both activation cotangents) and exceeds the
+    16M scoped-VMEM limit at bt=32 on MSR-VTT shapes."""
+    for bt in (32, 24, 16, 8):
+        if bt <= cap and B >= bt and B % bt == 0:
+            return bt
+    return None
+
+
+def _fwd_kernel(p_ref, q_ref, v_ref, vals_ref, mask_ref, ctx_ref, attn_ref):
+    # All contractions are rank-1 (score vector / attention weights), so
+    # they run as VPU multiply-reduce — Mosaic only lowers plain 2D dots,
+    # and the MXU would not help at these shapes anyway.
+    p = p_ref[:]
+    q = q_ref[:]
+    th = jnp.tanh(p + q[:, None, :])                       # (bt, F, A) cdt
+    vvec = v_ref[:][:, 0]                                  # (A,)
+    s = jnp.sum(
+        th.astype(jnp.float32) * vvec.astype(jnp.float32)[None, None, :],
+        axis=-1,
+    )                                                      # (bt, F) f32
+    s = jnp.where(mask_ref[:] > 0, s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    a = e / jnp.sum(e, axis=-1, keepdims=True)
+    attn_ref[:] = a
+    # Broadcast in f32: Mosaic only supports minor-dim insertion on
+    # 32-bit vectors (a bf16 [:, :, None] fails to lower).
+    ctx = jnp.sum(
+        a[:, :, None] * vals_ref[:].astype(jnp.float32), axis=1
+    )                                                      # (bt, E) f32
+    ctx_ref[:] = ctx.astype(ctx_ref.dtype)
+
+
+def _bwd_kernel(p_ref, q_ref, v_ref, vals_ref, a_ref, dctx_ref,
+                dp_ref, dq_ref, dv_ref, dvals_ref):
+    b = pl.program_id(0)
+    a = a_ref[:]                                           # (bt, F) f32
+    dctx = dctx_ref[:].astype(jnp.float32)                 # (bt, E)
+    vals = vals_ref[:]
+    # d(attn): back through ctx = sum_f a_f * vals_f.
+    da = jnp.sum(
+        dctx[:, None, :] * vals.astype(jnp.float32), axis=-1
+    )                                                      # (bt, F)
+    dvals_ref[:] = (
+        a[:, :, None] * dctx[:, None, :]
+    ).astype(dvals_ref.dtype)
+    # softmax backward.
+    ds = a * (da - jnp.sum(a * da, axis=-1, keepdims=True))  # (bt, F) f32
+    # s = tanh(p + q) . v — recompute tanh (never left VMEM forward).
+    th = jnp.tanh(p_ref[:] + q_ref[:][:, None, :]).astype(jnp.float32)
+    dv = jnp.sum(th * ds[:, :, None], axis=(0, 1))[None, :]  # (1, A)
+
+    @pl.when(b == 0)
+    def _():
+        dv_ref[:] = jnp.zeros_like(dv_ref)
+
+    dv_ref[:] += dv
+    vvec = v_ref[:].astype(jnp.float32)[:, 0]              # (A,)
+    dpre = ds[:, :, None] * vvec[None, None, :] * (1.0 - th * th)
+    dp_ref[:] = dpre.astype(dp_ref.dtype)
+    dq_ref[:] = jnp.sum(dpre, axis=1).astype(dq_ref.dtype)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fused_fwd_call(q, att_proj, att_mask, att_vals, att_v, bt):
+    B, F, A = att_proj.shape
+    E = att_vals.shape[-1]
+    grid = (B // bt,)
+    b3 = lambda w: pl.BlockSpec(  # noqa: E731
+        (bt, F, w), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    b2 = lambda w: pl.BlockSpec(  # noqa: E731
+        (bt, w), lambda b: (b, 0), memory_space=pltpu.VMEM
+    )
+    shared = pl.BlockSpec((A, 1), lambda b: (0, 0), memory_space=pltpu.VMEM)
+    ctx, attn = pl.pallas_call(
+        _fwd_kernel,
+        grid=grid,
+        in_specs=[b3(A), b2(A), shared, b3(E), b2(F)],
+        out_specs=[b2(E), b2(F)],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, E), att_vals.dtype),
+            jax.ShapeDtypeStruct((B, F), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(att_proj, q, att_v, att_vals, att_mask.astype(jnp.float32))
+    return ctx, attn
+
+
+def _fused_bwd_call(q, att_proj, att_vals, att_v, attn, dctx, bt):
+    B, F, A = att_proj.shape
+    E = att_vals.shape[-1]
+    grid = (B // bt,)
+    b3 = lambda w: pl.BlockSpec(  # noqa: E731
+        (bt, F, w), lambda b: (b, 0, 0), memory_space=pltpu.VMEM
+    )
+    b2 = lambda w: pl.BlockSpec(  # noqa: E731
+        (bt, w), lambda b: (b, 0), memory_space=pltpu.VMEM
+    )
+    shared_in = pl.BlockSpec(
+        (A, 1), lambda b: (0, 0), memory_space=pltpu.VMEM
+    )
+    shared_out = pl.BlockSpec(
+        (1, A), lambda b: (0, 0), memory_space=pltpu.VMEM
+    )
+    dp, dq, dv, dvals = pl.pallas_call(
+        _bwd_kernel,
+        grid=grid,
+        in_specs=[b3(A), b2(A), shared_in, b3(E), b2(F), b2(E)],
+        out_specs=[b3(A), b2(A), shared_out, b3(E)],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, F, A), att_proj.dtype),
+            jax.ShapeDtypeStruct((B, A), q.dtype),
+            jax.ShapeDtypeStruct((1, A), jnp.float32),
+            jax.ShapeDtypeStruct((B, F, E), att_vals.dtype),
+        ],
+        interpret=_interpret(),
+    )(att_proj, q, att_v, att_vals, attn, dctx)
+    return dp, dq, dv.reshape(A, 1).astype(att_v.dtype), dvals
+
+
+@jax.custom_vjp
+def _fused(q, att_proj, att_mask, att_vals, att_v):
+    bt = _pick_bt(q.shape[0])
+    ctx, _ = _fused_fwd_call(q, att_proj, att_mask, att_vals, att_v, bt)
+    return ctx
+
+
+def _fused_vjp_fwd(q, att_proj, att_mask, att_vals, att_v):
+    bt = _pick_bt(q.shape[0])
+    ctx, attn = _fused_fwd_call(q, att_proj, att_mask, att_vals, att_v, bt)
+    return ctx, (q, att_proj, att_mask, att_vals, att_v, attn)
+
+
+def _fused_vjp_bwd(res, dctx):
+    q, att_proj, att_mask, att_vals, att_v, attn = res
+    bt = _pick_bt(q.shape[0], cap=16)
+    dp, dq, dv, dvals = _fused_bwd_call(
+        q, att_proj, att_vals, att_v, attn, dctx, bt
+    )
+    return dq, dp, jnp.zeros_like(att_mask), dvals, dv
+
+
+_fused.defvjp(_fused_vjp_fwd, _fused_vjp_bwd)
+
+
+def fused_context_attention(q, att_proj, att_mask, att_vals, att_v,
+                            use_pallas: bool = True):
+    """One decode step of Bahdanau context attention.
+
+    Kernel path when enabled and the batch tiles; dense XLA otherwise.
+    """
+    if use_pallas and _pick_bt(q.shape[0]) is not None:
+        return _fused(q, att_proj, att_mask, att_vals, att_v)
+    return dense_context_attention(q, att_proj, att_mask, att_vals, att_v)
