@@ -116,6 +116,7 @@ class TrainConfig:
     remat: bool = False           # jax.checkpoint the decoder scan
     nan_check: bool = False       # debug nan-guard on losses/grads
     profile_dir: str = ""         # jax.profiler trace output ("" = off)
+    tensorboard_dir: str = ""     # tf.summary event files ("" = off)
     log_every: int = 20           # steps between loss log lines
     history_file: str = "history.json"
 

@@ -153,6 +153,45 @@ class Trainer:
             self._try_resume()
         # False = armed, True = tracing, None = finished/disabled.
         self._profiling = False if cfg.train.profile_dir else None
+        # Optional TensorBoard events (SURVEY.md §5 "Metrics / logging":
+        # the reference has history json only; tf.summary is the rebuild's
+        # optional extra).  Rank-0 only — one event stream per run.
+        self._tb = None
+        if cfg.train.tensorboard_dir and jax.process_index() == 0:
+            try:
+                import tensorflow as tf
+
+                # TF must never claim the accelerators JAX is using —
+                # its default GPU behavior preallocates nearly all
+                # device memory.  Summary writing is host-side only.
+                tf.config.set_visible_devices([], "GPU")
+                try:
+                    tf.config.set_visible_devices([], "TPU")
+                except (ValueError, RuntimeError):
+                    pass
+                self._tb = tf.summary.create_file_writer(
+                    cfg.train.tensorboard_dir
+                )
+            except ImportError:
+                log.warning(
+                    "train.tensorboard_dir set but tensorflow is not "
+                    "importable — TensorBoard logging disabled"
+                )
+
+    def _tb_log(self, epoch: int, entry: Dict) -> None:
+        if self._tb is None:
+            return
+        import tensorflow as tf
+
+        with self._tb.as_default(step=epoch):
+            for k, v in entry.items():
+                if isinstance(v, (int, float)) and np.isfinite(v):
+                    tf.summary.scalar(f"train/{k}", v)
+                elif isinstance(v, dict):  # val metrics
+                    for mk, mv in v.items():
+                        if isinstance(mv, (int, float)) and np.isfinite(mv):
+                            tf.summary.scalar(f"val/{mk}", mv)
+        self._tb.flush()
 
     # ------------------------------------------------------------- plumbing
     def _try_resume(self) -> None:
@@ -364,6 +403,7 @@ class Trainer:
                         "patience": self._patience,
                     },
                 )
+            self._tb_log(epoch, entry)
             self.history[str(epoch)] = entry
             # Rank-0 guard: every process keeps the in-memory history (it
             # feeds return values / resume), but only one writes the file

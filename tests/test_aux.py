@@ -110,6 +110,28 @@ class TestProfiler:
         assert any(os.path.isfile(p) for p in traces), "no trace files"
 
 
+class TestTensorBoard:
+    def test_event_files_written(self, tmp_path):
+        pytest.importorskip("tensorflow")
+        tb = str(tmp_path / "tb")
+        ds, cfg = smoke_trainer(tmp_path, tensorboard_dir=tb)
+        t = Trainer(cfg, train_ds=ds, val_ds=ds,
+                    workdir=str(tmp_path / "w4"))
+        t.fit()
+        events = glob.glob(os.path.join(tb, "events.out.tfevents.*"))
+        assert events, "no TensorBoard event files written"
+        # train scalars + val metrics both land in the stream
+        import tensorflow as tf
+
+        tags = set()
+        for path in events:
+            for ev in tf.compat.v1.train.summary_iterator(path):
+                for v in ev.summary.value:
+                    tags.add(v.tag)
+        assert any(tag.startswith("train/") for tag in tags), tags
+        assert any(tag.startswith("val/") for tag in tags), tags
+
+
 class TestPipeline:
     def test_staged_pipeline_runs_and_evaluates(self, tmp_path):
         from cst_captioning_tpu.cli.pipeline import run_pipeline

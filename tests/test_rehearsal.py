@@ -1,0 +1,37 @@
+"""End-to-end rehearsal tool: the full fabricate -> prep -> pack ->
+XE/WXE/CST pipeline -> beam eval chain at tiny scale."""
+
+import json
+
+import numpy as np
+
+from cst_captioning_tpu.tools.rehearsal import main
+
+
+def test_rehearsal_end_to_end(tmp_path, capsys):
+    rc = main([
+        "--out-dir", str(tmp_path / "r"),
+        "--videos", "16",
+        "--epochs", "1",
+        "--batch-size", "8",  # conftest's 8-device mesh shards the batch
+        "--max-frames", "4",
+        "--max-words", "8",
+        "--beam-size", "2",
+        "--cst-samples", "3",
+        "--feature-dims", "resnet=16,c3d=8",
+    ])
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary["videos"] == 16
+    for stage in ("xe", "wxe", "cst"):
+        assert stage in summary["stages"]
+        bc = summary["stages"][stage]["best_val_cider"]
+        assert bc is not None and np.isfinite(bc)
+    assert np.isfinite(summary["stages"]["cst"]["final_reward"])
+    scores = summary["test_scores"]
+    assert {"Bleu_4", "METEOR", "ROUGE_L", "CIDEr"} <= set(scores)
+    assert scores["METEOR_backend"] in ("java", "lite", "lite+syn")
+    # artifacts on disk: packed store, prep outputs, staged checkpoints
+    assert (tmp_path / "r" / "packed" / "resnet.npy").exists()
+    assert (tmp_path / "r" / "prep" / "consensus_train.json").exists()
+    assert (tmp_path / "r" / "checkpoints" / "rehearsal_cst").exists()
