@@ -57,7 +57,12 @@ def load_annotations(path: str, fmt: str) -> Tuple[
         splits: Dict[str, List[str]] = defaultdict(list)
         categories: Dict[str, int] = {}
         for v in raw["videos"]:
-            splits[v.get("split", "train")].append(v["video_id"])
+            # The real videodatainfo.json names the split "validate";
+            # the framework's canonical name is "val" (label/cocofmt
+            # file templates, pipeline best-checkpoint selection).
+            split = v.get("split", "train")
+            split = {"validate": "val"}.get(split, split)
+            splits[split].append(v["video_id"])
             categories[v["video_id"]] = int(v.get("category", 0))
         captions: Dict[str, List[str]] = defaultdict(list)
         for s in raw["sentences"]:

@@ -164,13 +164,17 @@ class Trainer:
                 # TF must never claim the accelerators JAX is using —
                 # its default GPU behavior preallocates nearly all
                 # device memory.  Summary writing is host-side only.
-                tf.config.set_visible_devices([], "GPU")
-                try:
-                    tf.config.set_visible_devices([], "TPU")
-                except (ValueError, RuntimeError):
-                    pass
+                # Best-effort: raises if TF already initialized devices.
+                for kind in ("GPU", "TPU"):
+                    try:
+                        tf.config.set_visible_devices([], kind)
+                    except (ValueError, RuntimeError):
+                        pass
+                # Namespace per run name: pipeline stages (xe/wxe/cst)
+                # each restart at epoch 0 — one shared logdir would
+                # interleave three unrelated curves under the same tags.
                 self._tb = tf.summary.create_file_writer(
-                    cfg.train.tensorboard_dir
+                    os.path.join(cfg.train.tensorboard_dir, cfg.name)
                 )
             except ImportError:
                 log.warning(

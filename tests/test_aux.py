@@ -118,7 +118,10 @@ class TestTensorBoard:
         t = Trainer(cfg, train_ds=ds, val_ds=ds,
                     workdir=str(tmp_path / "w4"))
         t.fit()
-        events = glob.glob(os.path.join(tb, "events.out.tfevents.*"))
+        # Events are namespaced per run name under the logdir.
+        events = glob.glob(
+            os.path.join(tb, "**", "events.out.tfevents.*"), recursive=True
+        )
         assert events, "no TensorBoard event files written"
         # train scalars + val metrics both land in the stream
         import tensorflow as tf

@@ -161,6 +161,28 @@ class TestShardFramesModel:
             np.asarray(out_s), np.asarray(out_d), rtol=2e-5, atol=2e-5
         )
 
+    def test_shard_frames_takes_priority_over_pallas_kernel(self):
+        """With both shard_frames and use_pallas_attention set, the
+        sharded (exact, collective) path wins — and still matches dense."""
+        from cst_captioning_tpu.models import model_from_config
+
+        cfg = self._cfg()
+        mesh = make_mesh({"data": 2, "model": 4})
+        rng = np.random.RandomState(8)
+        feats, masks, ids = self._batch(cfg, rng)
+        dense = model_from_config(cfg)
+        cfg.model.shard_frames = True
+        cfg.model.use_pallas_attention = True
+        both = model_from_config(cfg, mesh=mesh)
+        assert both.shard_frames
+        params = dense.init(jax.random.PRNGKey(0), feats, masks, ids)
+        np.testing.assert_allclose(
+            np.asarray(both.apply(params, feats, masks, ids)),
+            np.asarray(dense.apply(params, feats, masks, ids)),
+            rtol=2e-5,
+            atol=2e-5,
+        )
+
     def test_grads_match_dense(self):
         """Training differentiates through the shard_map body (pmax needs
         the stop_gradient-inside construction) — grads must equal dense."""
