@@ -81,6 +81,17 @@ def run_pipeline(
         last = os.path.join(trainer.workdir, "last")
         prev_best = best if os.path.exists(best) else last
         last_cfg = cfg
+        if trainer.preempted:
+            # The stage was evicted mid-run: later stages would warm-start
+            # from a truncated checkpoint and the eval would score junk.
+            # Record where the pipeline stopped; `train.resume` continues
+            # this stage from its preemption checkpoint.
+            results["preempted"] = {"stage": stage, "checkpoint": last}
+            log.warning(
+                "pipeline preempted during stage %s — stopping (resume "
+                "with train.resume=True to continue)", stage,
+            )
+            return results
         log.info("stage %s done; checkpoint %s", stage, prev_best)
 
     if eval_split:

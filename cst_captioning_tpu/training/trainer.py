@@ -153,6 +153,9 @@ class Trainer:
             self._try_resume()
         # False = armed, True = tracing, None = finished/disabled.
         self._profiling = False if cfg.train.profile_dir else None
+        # Set when fit() exits through the preemption path — callers
+        # (cli/pipeline.py) must not continue to later stages.
+        self.preempted = False
         # Optional TensorBoard events (SURVEY.md §5 "Metrics / logging":
         # the reference has history json only; tf.summary is the rebuild's
         # optional extra).  Rank-0 only — one event stream per run.
@@ -242,6 +245,36 @@ class Trainer:
     def _category(self, batch) -> Optional[jax.Array]:
         return batch.category if self.model.use_category else None
 
+    def _stop_agreed(self, stop_flag) -> bool:
+        """Global stop decision.  Multi-host: every process contributes
+        its local flag through an UNCONDITIONAL per-step allgather (a
+        conditional collective would deadlock), so all hosts break at the
+        same step boundary and the coordinated checkpoint save sees
+        identical state everywhere.  Single-host: just the local flag."""
+        if jax.process_count() == 1:
+            return stop_flag.triggered
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.int32(stop_flag.triggered)
+        )
+        return bool(np.max(flags))
+
+    def _last_extra(self, epoch: int, **overrides) -> Dict:
+        """Resume metadata for a `last` checkpoint — shared by the
+        periodic and preemption save sites so new counters can't drift
+        between them."""
+        extra = {
+            "epoch": epoch,
+            "best_score": (
+                None if self.best_score == -np.inf else self.best_score
+            ),
+            "best_epoch": self.best_epoch,
+            "patience": self._patience,
+        }
+        extra.update(overrides)
+        return extra
+
     def _profile_step(self, epoch: int, nsteps: int) -> None:
         """jax.profiler trace of the first ~10 steps of the first epoch
         (SURVEY.md §5 "Tracing / profiling" — absent in the reference);
@@ -258,7 +291,7 @@ class Trainer:
             log.info("profiler trace written to %s", self.cfg.train.profile_dir)
 
     # ------------------------------------------------------------ training
-    def train_epoch(self, epoch: int) -> Dict[str, float]:
+    def train_epoch(self, epoch: int, stop_flag=None) -> Dict[str, float]:
         cfg = self.cfg
         ss_prob = scheduled_sampling_prob(cfg.model, epoch)
         # Plain XE ignores consensus weights (reference train_mode switch).
@@ -272,6 +305,15 @@ class Trainer:
         for batch in prefetch_to_device(
             self.train_iter.epoch(epoch), sharding=self._batch_sharding
         ):
+            # Poll BEFORE dispatching (a post-signal step would fold an
+            # extra update into state the checkpoint labels as epoch-1,
+            # and would eat into the eviction grace window).
+            if stop_flag is not None and self._stop_agreed(stop_flag):
+                log.warning(
+                    "preemption: stopping epoch %d before step %d",
+                    epoch, nsteps,
+                )
+                break
             step_rng = jax.random.fold_in(epoch_rng, nsteps)
             weights = (
                 batch.weights
@@ -358,9 +400,30 @@ class Trainer:
 
     # ----------------------------------------------------------------- fit
     def fit(self) -> Dict[str, dict]:
+        from cst_captioning_tpu.training.preemption import PreemptionGuard
+
         cfg = self.cfg
+        # SIGTERM (TPU/GKE eviction) -> save `last` + clean exit; resume
+        # picks up exactly where the run stopped (SURVEY.md §5).
+        guard = PreemptionGuard.install()
         for epoch in range(self.start_epoch, cfg.train.max_epochs):
-            entry = self.train_epoch(epoch)
+            entry = self.train_epoch(epoch, stop_flag=guard)
+            if self._stop_agreed(guard):
+                # Mark the last COMPLETED epoch: the interrupted epoch
+                # replays in full on resume (per-epoch fold_in RNG makes
+                # the replay deterministic; partial-epoch updates in the
+                # saved params are conservatively re-trained).
+                ckpt.save_checkpoint(
+                    os.path.join(self.workdir, "last"),
+                    self.state,
+                    self._last_extra(epoch - 1, preempted_during=epoch),
+                )
+                self.preempted = True
+                log.warning(
+                    "preemption checkpoint saved (%s); exiting fit",
+                    os.path.join(self.workdir, "last"),
+                )
+                break
             if self.val_ds is not None and (epoch + 1) % cfg.train.eval_every == 0:
                 val = self.evaluate()
                 entry["val"] = val
@@ -395,17 +458,7 @@ class Trainer:
                 ckpt.save_checkpoint(
                     os.path.join(self.workdir, "last"),
                     self.state,
-                    {
-                        "epoch": epoch,
-                        "history": entry,
-                        "best_score": (
-                            None
-                            if self.best_score == -np.inf
-                            else self.best_score
-                        ),
-                        "best_epoch": self.best_epoch,
-                        "patience": self._patience,
-                    },
+                    self._last_extra(epoch, history=entry),
                 )
             self._tb_log(epoch, entry)
             self.history[str(epoch)] = entry
