@@ -144,6 +144,11 @@ def main(argv=None) -> int:
     with open(out, "w") as f:
         json.dump(results, f, indent=2, default=str)
     print(json.dumps(results.get("eval", {}), default=str))
+    if "preempted" in results:
+        # Non-zero so orchestrators (k8s restartPolicy, wrappers checking
+        # exit status) reschedule the job; resume continues the stage.
+        # 75 = EX_TEMPFAIL: transient, retry.
+        return 75
     return 0
 
 

@@ -102,6 +102,14 @@ class TrainConfig:
     beta2: float = 0.999
     epsilon: float = 1e-8
 
+    # PRNG implementation for all training randomness.  "rbg" rides the
+    # TPU's hardware generator and partitions cleanly under SPMD —
+    # threefry2x32 costs ~10ms/step generating the (B,S,T,H) dropout mask
+    # alone at MSR-VTT shape (docs/PERF.md) and dominates rollout
+    # sampling.  Streams are deterministic per impl but differ across
+    # impls; set "threefry2x32" to reproduce older runs bit-for-bit.
+    rng_impl: str = "rbg"
+
     max_epochs: int = 50
     max_patience: int = 5         # early stop on val CIDEr
     eval_every: int = 1           # epochs between val language evals

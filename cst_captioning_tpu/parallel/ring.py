@@ -169,13 +169,16 @@ def ulysses_attention(
     """
     ways = mesh.shape[axis]
     B, S, H, D = q.shape
-    if S % ways or H % ways:
+    S_kv = k.shape[1]
+    # Cross-length attention (S_q != S_kv) is legal, like ring_attention:
+    # both sequence axes ride the all_to_all, so both must divide.
+    if S % ways or S_kv % ways or H % ways:
         raise ValueError(
-            f"ulysses_attention needs seq ({S}) and heads ({H}) divisible "
-            f"by mesh axis {axis!r} ({ways})"
+            f"ulysses_attention needs q seq ({S}), kv seq ({S_kv}) and "
+            f"heads ({H}) divisible by mesh axis {axis!r} ({ways})"
         )
     if kv_mask is None:
-        kv_mask = jnp.ones((B, S), jnp.float32)
+        kv_mask = jnp.ones(k.shape[:2], jnp.float32)
     scale = 1.0 / (D ** 0.5)
     qspec = P(batch_axis, axis, None, None)
     mspec = P(batch_axis, axis)

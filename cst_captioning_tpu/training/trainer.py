@@ -129,8 +129,10 @@ class Trainer:
 
         # All training randomness is derived per (seed, epoch, step) via
         # fold_in — resume-from-checkpoint reproduces the exact stream an
-        # uninterrupted run would have used.
-        self._base_rng = jax.random.PRNGKey(cfg.train.seed)
+        # uninterrupted run would have used.  The impl is passed to the
+        # key itself (keys carry their impl; every derived key inherits
+        # it) — NOT via global config, which would leak across trainers.
+        self._base_rng = self._make_base_rng(cfg.train.rng_impl)
         init_rng = jax.random.fold_in(self._base_rng, 0x5EED)
         first = next(iter(self.train_iter.epoch(0)))
         self.state = create_train_state(
@@ -201,6 +203,14 @@ class Trainer:
         self._tb.flush()
 
     # ------------------------------------------------------------- plumbing
+    def _make_base_rng(self, impl: str) -> jax.Array:
+        # TYPED keys (jax.random.key) carry their impl through every
+        # fold_in/split/bernoulli downstream; raw PRNGKey arrays would be
+        # re-interpreted under the process default impl.
+        if impl:
+            return jax.random.key(self.cfg.train.seed, impl=impl)
+        return jax.random.PRNGKey(self.cfg.train.seed)
+
     def _try_resume(self) -> None:
         """Preemption recovery (SURVEY.md §5 "resume-from-checkpoint"):
         restore params+optimizer+step from <workdir>/last, continue at the
@@ -211,6 +221,18 @@ class Trainer:
             log.info("resume requested but no checkpoint at %s — fresh run",
                      last)
             return
+        saved_impl = infos.get("rng_impl")
+        if saved_impl and saved_impl != self.cfg.train.rng_impl:
+            # The checkpoint's stream was generated under a different
+            # PRNG impl; honor it so the resumed run replays the exact
+            # stream the uninterrupted run would have used.
+            log.warning(
+                "resume: checkpoint used rng_impl=%s (config says %s) — "
+                "using the checkpoint's impl",
+                saved_impl, self.cfg.train.rng_impl,
+            )
+            self.cfg.train.rng_impl = saved_impl
+            self._base_rng = self._make_base_rng(saved_impl)
         self.state = ckpt.restore_checkpoint(last, self.state)
         self.start_epoch = int(infos["epoch"]) + 1
         bs = infos.get("best_score")
@@ -245,14 +267,24 @@ class Trainer:
     def _category(self, batch) -> Optional[jax.Array]:
         return batch.category if self.model.use_category else None
 
-    def _stop_agreed(self, stop_flag) -> bool:
-        """Global stop decision.  Multi-host: every process contributes
-        its local flag through an UNCONDITIONAL per-step allgather (a
-        conditional collective would deadlock), so all hosts break at the
-        same step boundary and the coordinated checkpoint save sees
-        identical state everywhere.  Single-host: just the local flag."""
+    # Multi-host preemption agreement cadence: the allgather must run at
+    # the SAME steps on every host (a conditional collective deadlocks),
+    # so it fires on a fixed step modulus — cheap enough to stay off the
+    # hot path, frequent enough to act well inside an eviction grace
+    # window.
+    PREEMPTION_SYNC_EVERY = 10
+
+    def _stop_agreed(self, stop_flag, step: Optional[int] = None) -> bool:
+        """Global stop decision.  Single-host: the local flag.  Multi-host:
+        an allgather of every process's flag — run unconditionally at
+        fixed step boundaries (``step % PREEMPTION_SYNC_EVERY == 0``, or
+        always when ``step`` is None, e.g. at epoch ends) so all hosts
+        break at the same point and the coordinated checkpoint save sees
+        identical state everywhere."""
         if jax.process_count() == 1:
             return stop_flag.triggered
+        if step is not None and step % self.PREEMPTION_SYNC_EVERY != 0:
+            return False
         from jax.experimental import multihost_utils
 
         flags = multihost_utils.process_allgather(
@@ -271,6 +303,9 @@ class Trainer:
             ),
             "best_epoch": self.best_epoch,
             "patience": self._patience,
+            # Resume replays the RNG stream — which only reproduces under
+            # the SAME prng impl; recorded so resume can match it.
+            "rng_impl": self.cfg.train.rng_impl,
         }
         extra.update(overrides)
         return extra
@@ -308,7 +343,9 @@ class Trainer:
             # Poll BEFORE dispatching (a post-signal step would fold an
             # extra update into state the checkpoint labels as epoch-1,
             # and would eat into the eviction grace window).
-            if stop_flag is not None and self._stop_agreed(stop_flag):
+            if stop_flag is not None and self._stop_agreed(
+                stop_flag, step=nsteps
+            ):
                 log.warning(
                     "preemption: stopping epoch %d before step %d",
                     epoch, nsteps,

@@ -43,10 +43,16 @@ from cst_captioning_tpu.config import get_preset
 from cst_captioning_tpu.data import make_synthetic_dataset
 from cst_captioning_tpu.training import Trainer
 
+from cst_captioning_tpu.training.preemption import PreemptionGuard
+
 cfg = get_preset("synthetic_smoke")
 cfg.train.max_epochs = 500          # would run ~forever without the signal
 cfg.train.checkpoint_dir = os.path.join(workdir, "ck")
 cfg.train.save_checkpoint_every = 10**6   # only the preemption save writes
+# Install BEFORE the (slow, jit-compiling) Trainer construction so the
+# timer can never race an uninstalled handler; fit()'s install is
+# idempotent and returns this same guard.
+PreemptionGuard.install()
 ds, _ = make_synthetic_dataset(num_videos=16, max_frames=6)
 t = Trainer(cfg, train_ds=ds, val_ds=None, workdir=workdir)
 

@@ -123,6 +123,23 @@ class TestUlyssesAttention:
         q = jnp.zeros((2, 64, 6, 16))  # 6 heads not divisible by 8
         with pytest.raises(ValueError, match="divisible"):
             ulysses_attention(q, q, q, mesh)
+        qq = jnp.zeros((2, 64, 8, 16))
+        kk = jnp.zeros((2, 60, 8, 16))  # kv seq not divisible by 8
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_attention(qq, kk, kk, mesh)
+
+    def test_cross_length(self, mesh):
+        """S_q != S_kv (legal, like ring); default mask follows k."""
+        rng = np.random.RandomState(13)
+        B, Sq, Skv, H, D = 2, 32, 64, 8, 16
+        q = jnp.asarray(rng.randn(B, Sq, H, D), jnp.float32)
+        k = jnp.asarray(rng.randn(B, Skv, H, D), jnp.float32)
+        v = jnp.asarray(rng.randn(B, Skv, H, D), jnp.float32)
+        ref = self.dense_mha(q, k, v, jnp.ones((B, Skv)))
+        got = ulysses_attention(q, k, v, mesh)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6
+        )
 
     def test_composes_with_batch_axis(self):
         mesh2 = make_mesh({"data": 2, "model": 4})

@@ -109,6 +109,28 @@ class TestTrainerXE:
         # history json written
         assert os.path.exists(os.path.join(trainer.workdir, "history.json"))
 
+    def test_category_embedding_end_to_end(self, tmp_path):
+        """MSR-VTT category conditioning: train + greedy-val + beam eval
+        all thread the (B,) category ids through the model."""
+        from cst_captioning_tpu.data import make_synthetic_dataset
+        from cst_captioning_tpu.evaluation import evaluate_dataset
+
+        ds, _ = make_synthetic_dataset(
+            num_videos=16, max_frames=6, num_categories=5, seed=7
+        )
+        cfg = smoke_cfg(tmp_path)
+        cfg.model.use_category = True
+        cfg.data.num_categories = 5
+        cfg.train.max_epochs = 2
+        trainer = Trainer(cfg, train_ds=ds, val_ds=ds)
+        hist = trainer.fit()
+        assert np.isfinite(hist["1"]["train_loss"])
+        assert "cat_embed" in trainer.state.params["params"]
+        scores, preds = evaluate_dataset(
+            trainer.model, trainer.state.params, ds, cfg
+        )
+        assert len(preds) == len(ds) and np.isfinite(scores["CIDEr"])
+
     def test_wxe_uses_weights_and_runs(self, corpus, tmp_path):
         ds, _ = corpus
         cfg = smoke_cfg(tmp_path, train_mode="wxe")
