@@ -18,8 +18,9 @@ self-consensus (SCB) baseline, in-loop CIDEr-D over 20 refs/video.
 
 Env knobs: BENCH_CHUNK (steps per dispatch), BENCH_ITERS, BENCH_PALLAS,
 BENCH_CST=0 to skip the CST section, BENCH_ATTN=0 to skip the
-attention-fusion XE bench (it compiles a second model), BENCH_LOADER=0
-to skip the packed-loader assembly bench.
+attention-fusion XE bench (it compiles a second model), BENCH_DECODE=0
+to skip greedy/beam decode throughput, BENCH_LOADER=0 to skip the
+packed-loader assembly bench, BENCH_RNG to override the PRNG impl.
 """
 
 from __future__ import annotations
@@ -280,6 +281,66 @@ def bench_cst():
     }
 
 
+def bench_decode():
+    """Inference throughput: greedy decode (the per-epoch validation
+    pass) and beam-5 decode (the test eval), videos/sec on one chip at
+    MSR-VTT shape."""
+    from cst_captioning_tpu.decoding.beam import make_beam_search_fn
+    from cst_captioning_tpu.models import model_from_config
+    from cst_captioning_tpu.training.steps import make_greedy_sample_fn
+
+    cfg = _msrvtt_cfg()
+    B = cfg.data.batch_size
+    batch = _fake_batch(cfg, np.random.RandomState(3))
+    model = model_from_config(cfg)
+    feats = {m: jnp.asarray(v) for m, v in batch["feats"].items()}
+    masks = {m: jnp.asarray(v) for m, v in batch["feat_masks"].items()}
+    params = model.init(
+        jax.random.PRNGKey(0), feats, masks,
+        jnp.ones((B, 2), jnp.int32),
+    )
+    out = {}
+    greedy = make_greedy_sample_fn(model, cfg.eval.max_decode_len)
+    beam = make_beam_search_fn(
+        model, beam_size=cfg.eval.beam_size,
+        max_len=cfg.eval.max_decode_len,
+    )
+
+    first_m = next(iter(feats))
+
+    def timed(fn, label):
+        def reps(params):
+            def body(c, _):
+                # Carry-dependent input perturbation (numerically zero,
+                # but data-dependent) so loop-invariant code motion can't
+                # hoist the decode out of the scan and deflate dt.
+                bump = jnp.where(c == jnp.int32(-1), 1e-6, 0.0)
+                f = dict(feats)
+                f[first_m] = f[first_m] + bump
+                toks = fn(params, f)
+                return c + toks.sum(), None
+            acc, _ = jax.lax.scan(body, jnp.int32(0), None, length=5)
+            return acc
+        r = jax.jit(reps)
+        float(r(params))
+        ts = []
+        for _ in range(4):
+            t0 = time.perf_counter()
+            float(r(params))
+            ts.append(time.perf_counter() - t0)
+        dt = sorted(ts)[len(ts) // 2] / 5
+        out[label] = round(B / dt, 1)
+
+    timed(
+        lambda p, f: greedy(p, f, masks, None), "greedy_videos_per_sec"
+    )
+    timed(
+        lambda p, f: beam(p, f, masks, None).tokens,
+        f"beam{cfg.eval.beam_size}_videos_per_sec",
+    )
+    return out
+
+
 def bench_loader():
     """Host batch assembly from the packed feature store at MSR-VTT shape
     (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
@@ -376,6 +437,11 @@ def main() -> int:
             extra.update(bench_cst())
         except Exception as e:  # CST bench must never sink the headline
             extra["cst_error"] = f"{type(e).__name__}: {e}"
+    if os.environ.get("BENCH_DECODE", "1") == "1":
+        try:
+            extra.update(bench_decode())
+        except Exception as e:
+            extra["decode_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("BENCH_LOADER", "1") == "1":
         try:
             ms = bench_loader()
