@@ -1,0 +1,69 @@
+"""parallel/distributed.ensure_initialized: env-driven bootstrap logic
+(single-process no-op, explicit coordinator, env-var plumbing) without a
+real multi-process rendezvous (that path is covered by
+tests/test_distributed.py)."""
+
+from unittest import mock
+
+import pytest
+
+from cst_captioning_tpu.parallel import distributed
+
+
+@pytest.fixture(autouse=True)
+def reset_state(monkeypatch):
+    monkeypatch.setattr(distributed, "_INITIALIZED", False)
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES",
+                "JAX_PROCESS_ID", "TPU_WORKER_HOSTNAMES",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    yield
+
+
+def test_single_process_is_noop():
+    with mock.patch("jax.distributed.initialize") as init:
+        distributed.ensure_initialized()
+        init.assert_not_called()
+    assert not distributed._INITIALIZED
+
+
+def test_explicit_coordinator_initializes():
+    with mock.patch("jax.distributed.initialize") as init:
+        distributed.ensure_initialized(
+            coordinator_address="host:1234", num_processes=2, process_id=1
+        )
+        init.assert_called_once_with(
+            coordinator_address="host:1234", num_processes=2, process_id=1
+        )
+    assert distributed._INITIALIZED
+
+
+def test_env_vars_plumb_through(monkeypatch):
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "envhost:9")
+    monkeypatch.setenv("JAX_NUM_PROCESSES", "4")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")  # rank 0 must survive `or`
+    with mock.patch("jax.distributed.initialize") as init:
+        distributed.ensure_initialized()
+        init.assert_called_once_with(
+            coordinator_address="envhost:9", num_processes=4, process_id=0
+        )
+
+
+def test_idempotent():
+    with mock.patch("jax.distributed.initialize") as init:
+        distributed.ensure_initialized(
+            coordinator_address="host:1", num_processes=2, process_id=0
+        )
+        distributed.ensure_initialized(
+            coordinator_address="host:1", num_processes=2, process_id=0
+        )
+        assert init.call_count == 1
+
+
+def test_tpu_pod_env_triggers_autodetect(monkeypatch):
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "w0,w1")
+    with mock.patch("jax.distributed.initialize") as init:
+        distributed.ensure_initialized()
+        init.assert_called_once_with(
+            coordinator_address=None, num_processes=None, process_id=None
+        )
