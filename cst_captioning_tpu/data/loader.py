@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from typing import Dict, Iterator, List, NamedTuple, Optional
+from typing import Dict, Iterator, List, NamedTuple
 
 import numpy as np
 
@@ -176,8 +176,6 @@ def prefetch_to_device(
     in the mesh path), so H2D transfer overlaps the previous step's compute.
     ``video_ids`` stays on host.
     """
-    import jax
-
     q: "queue.Queue" = queue.Queue(maxsize=size)
     END = object()
     stop = threading.Event()

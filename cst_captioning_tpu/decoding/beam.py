@@ -21,7 +21,7 @@ TPU-first design (NOT the reference's per-video Python loop):
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Optional
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp

@@ -9,7 +9,6 @@ import json
 import os
 from typing import Dict, Optional, Tuple
 
-import jax
 import numpy as np
 
 from cst_captioning_tpu.config import Config

@@ -13,8 +13,6 @@ All reductions are in float32 regardless of activation dtype.
 
 from __future__ import annotations
 
-from typing import Optional
-
 import jax
 import jax.numpy as jnp
 

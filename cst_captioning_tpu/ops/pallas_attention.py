@@ -28,7 +28,6 @@ backend (interpret mode covers CPU tests).
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -9,7 +9,6 @@ collective over ``model``) on the ICI mesh.
 from __future__ import annotations
 
 import re
-from typing import Any, Dict
 
 import jax
 import numpy as np

@@ -23,14 +23,13 @@ TPU-first design:
 from __future__ import annotations
 
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import List, Optional, Sequence
 
 import numpy as np
 
 from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID, UNK_ID
 from cst_captioning_tpu.data.datasets import CaptionDataset
 from cst_captioning_tpu.metrics.cider import (
-    NGRAMS,
     _CiderBase,
     ciderd_score_vec,
     compute_doc_freq,

@@ -10,7 +10,7 @@ optimizer, so no Python-side LR mutation exists.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict
 
 import jax
 import jax.numpy as jnp

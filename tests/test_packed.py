@@ -2,7 +2,6 @@
 reads, loader fast-path equivalence, CLI converter, and an assembly
 throughput sanity check (SURVEY.md hot loop #3)."""
 
-import os
 import time
 
 import numpy as np

@@ -12,7 +12,7 @@ from cst_captioning_tpu.ops.pallas_lstm import (
     lstm_recurrence_pallas,
     lstm_recurrence_scan,
 )
-from cst_captioning_tpu.ops.rnn import LSTMWeights, init_lstm_weights, lstm_step
+from cst_captioning_tpu.ops.rnn import init_lstm_weights, lstm_step
 
 
 @pytest.fixture(scope="module")
