@@ -36,6 +36,14 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Steps per timed dispatch (see bench_xe): single source of truth so the
+# recorded `bench_chunk` extra always matches what actually ran.
+DEFAULT_CHUNK = 60
+
+
+def bench_chunk() -> int:
+    return int(os.environ.get("BENCH_CHUNK", str(DEFAULT_CHUNK)))
+
 
 def _msrvtt_cfg():
     from cst_captioning_tpu.config import get_preset
@@ -141,7 +149,7 @@ def bench_xe(fusion: str = "meanpool"):
     # chunk=10, ~0.2x of any improvement is this measurement fix — the
     # matched-chunk algorithmic speedup this round is ~1.18x (rbg PRNG,
     # docs/PERF.md).
-    chunk = int(os.environ.get("BENCH_CHUNK", "60"))
+    chunk = bench_chunk()
     iters = int(os.environ.get("BENCH_ITERS", "6"))
 
     def run_chunk(state, rng, *op):
@@ -421,7 +429,7 @@ def main() -> int:
 
     extra = {
         "xe_tflops_per_sec_chip": round(tflops, 2),
-        "bench_chunk": int(os.environ.get("BENCH_CHUNK", "60")),
+        "bench_chunk": bench_chunk(),
     }
     # v5e bf16 peak ~197 TFLOP/s; report MFU only when that's plausible.
     dev = jax.devices()[0]
