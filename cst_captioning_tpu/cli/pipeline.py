@@ -49,8 +49,14 @@ def run_pipeline(
     base_cfg: Config,
     stages: List[str],
     eval_split: Optional[str] = "test",
+    stage_overrides: Optional[Dict[str, Dict]] = None,
 ) -> Dict[str, dict]:
-    """Run the staged pipeline; returns {stage: history} + final scores."""
+    """Run the staged pipeline; returns {stage: history} + final scores.
+
+    ``stage_overrides``: {stage: {dotted.key: value}} applied AFTER the
+    stage recipe — hyperparameter sweeps (e.g. the CST learning rate)
+    tune a stage without editing ``STAGE_RECIPES``.
+    """
     from cst_captioning_tpu.training.trainer import Trainer
 
     train_ds, vocab = build_dataset(base_cfg, "train")
@@ -68,7 +74,9 @@ def run_pipeline(
             raise KeyError(
                 f"unknown stage {stage!r}; have {sorted(STAGE_RECIPES)}"
             )
-        cfg = base_cfg.replace(**STAGE_RECIPES[stage])
+        recipe = dict(STAGE_RECIPES[stage])
+        recipe.update((stage_overrides or {}).get(stage, {}))
+        cfg = base_cfg.replace(**recipe)
         cfg.name = f"{base_cfg.name}_{stage}"
         cfg.train.start_from = prev_best
         trainer = Trainer(cfg, train_ds=train_ds, val_ds=val_ds)

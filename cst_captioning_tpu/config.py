@@ -91,6 +91,15 @@ class TrainConfig:
     # consensus weight (driver config 4: "20-ref weighted CIDEr").
     cst_weighted_reward: bool = False
     sample_temperature: float = 1.0
+    # Split-step scoring pipeline (backends without io_callback): the
+    # rollout is dispatched in this many equal batch chunks, all enqueued
+    # on the device back-to-back, and the host CIDEr-D scorer consumes
+    # chunk i while chunks i+1..K still compute — device idle shrinks to
+    # ~1/K of the scoring time with identical math (every chunk samples
+    # from the same params).  1 = unchunked (bit-matches the one-graph
+    # rollout stream for a given rng).  Values that don't divide the
+    # batch fall back to the largest divisor.
+    cst_score_chunks: int = 4
 
     optimizer: str = "adam"
     learning_rate: float = 2e-4

@@ -543,12 +543,34 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
     batch_axis = (
         "data" if mesh is not None and mesh.shape.get("data", 1) > 1 else None
     )
+    use_pallas_attention = getattr(m, "use_pallas_attention", False)
+    if (
+        use_pallas_attention
+        and mesh is not None
+        and mesh.devices.size > 1
+    ):
+        # pallas_call has no SPMD partitioning rule: inside the jitted,
+        # batch-sharded train step it would fail to lower (or force a full
+        # gather) and _pick_bt would tile from the GLOBAL batch.  The
+        # dense XLA attention math shards fine; frame sharding
+        # (shard_frames) is the multi-device fast path.  Disabled even
+        # when shard_frames is set: _context's non-divisible-frames
+        # fallback would otherwise still reach the kernel.
+        import logging
+
+        logging.getLogger("cst_captioning_tpu.models").warning(
+            "use_pallas_attention disabled: the fused kernel has no SPMD "
+            "partitioning rule for the %d-device mesh — using the dense "
+            "attention math (set model.shard_frames for sharded fusion)",
+            mesh.devices.size,
+        )
+        use_pallas_attention = False
     return CaptionModel(
         shard_frames=shard_frames,
         frame_mesh=mesh if shard_frames else None,
         frame_axis="model",
         frame_batch_axis=batch_axis if shard_frames else None,
-        use_pallas_attention=getattr(m, "use_pallas_attention", False),
+        use_pallas_attention=use_pallas_attention,
         vocab_size=m.vocab_size,
         rnn_size=m.rnn_size,
         num_layers=m.num_layers,

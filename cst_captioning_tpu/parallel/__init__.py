@@ -38,6 +38,5 @@ from cst_captioning_tpu.parallel.sharding import (  # noqa: F401
 from cst_captioning_tpu.parallel.ring import (  # noqa: F401
     ring_attention,
     sharded_context_attention,
-    ulysses_attention,
 )
 from cst_captioning_tpu.parallel import distributed  # noqa: F401

@@ -6,7 +6,7 @@ streams as first-class: hour-long videos at dense frame rates produce
 sequences that do not fit one chip's HBM, and attention over them must
 shard the SEQUENCE axis, not just the batch.
 
-Three primitives, all exact (not approximations):
+Two primitives, both exact (not approximations):
 
 * :func:`ring_attention` — blockwise-softmax attention where Q/K/V are
   sharded along the sequence axis; K/V blocks rotate around the ring via
@@ -14,11 +14,6 @@ Three primitives, all exact (not approximations):
   transfer), with flash-attention-style running (m, l, o) accumulators in
   float32.  This is the standard ring-attention construction
   (arXiv:2310.01889) built on ``shard_map`` + XLA collectives.
-* :func:`ulysses_attention` — the all-to-all sequence-parallel layout
-  (arXiv:2309.14509): one all_to_all pair swaps the sequence shard for a
-  head shard, each device attends densely over the full sequence for its
-  heads.  Complements ring (fewer, bigger collectives vs streaming
-  exchanges with O(S/P) memory).
 * :func:`sharded_context_attention` — the captioner's Bahdanau
   single-query attention with the FRAME axis sharded: each device scores
   its local frames and the global softmax is assembled with one psum of
@@ -29,6 +24,11 @@ Both are tested for exactness against the dense computation on the
 8-device CPU mesh (tests/test_ring.py).  ``sharded_context_attention`` is
 integrated into the captioner behind ``model.shard_frames``
 (models/captioner.py ``_context``), composing with the DP batch axis.
+
+(An all-to-all "Ulysses" variant existed in round 2 but was removed:
+every attention in this model family is single-query Bahdanau — there is
+no multi-head axis for the all_to_all to re-shard, so no non-test code
+could ever call it; VERDICT r2 weak #4.)
 """
 
 from __future__ import annotations
@@ -119,74 +119,6 @@ def ring_attention(
         mesh=mesh,
         in_specs=(spec, spec, spec, mspec),
         out_specs=spec,
-    )
-    return fn(q, k, v, kv_mask)
-
-
-def _ulysses_body(q, k, v, kv_mask, axis: str, scale: float):
-    """shard_map body: inputs sequence-sharded (B, S/P, H, D); all_to_all
-    re-shards heads so each device holds the FULL sequence for H/P heads,
-    attends densely, and all_to_alls back.  One collective pair per call
-    (vs ring's P-1 neighbor exchanges) — the better layout when S/P chunks
-    are small and head count is divisible."""
-    # seq-shard -> head-shard: split heads (axis 2), concat sequence (1).
-    qh = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
-    kh = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
-    vh = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
-    mask_full = jax.lax.all_gather(kv_mask, axis, axis=1, tiled=True)  # (B, S)
-    s = jnp.einsum(
-        "bqhd,bkhd->bhqk",
-        qh.astype(jnp.float32) * scale,
-        kh.astype(jnp.float32),
-    )
-    s = jnp.where(mask_full[:, None, None, :] > 0, s, NEG_INF)
-    a = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", a, vh.astype(jnp.float32))
-    out = out.astype(q.dtype)
-    # head-shard -> seq-shard: split sequence (1), concat heads (2).
-    return jax.lax.all_to_all(
-        out, axis, split_axis=1, concat_axis=2, tiled=True
-    )
-
-
-def ulysses_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    mesh: Mesh,
-    axis: str = "model",
-    kv_mask: Optional[jax.Array] = None,
-    batch_axis: Optional[str] = None,
-) -> jax.Array:
-    """Exact multi-head attention with Q/K/V (B, S, H, D) sharded along S
-    over ``axis`` — the all-to-all ("Ulysses", arXiv:2309.14509) layout.
-
-    Requires S and the head count H both divisible by the axis size.
-    ``kv_mask`` (B, S) marks valid key positions.  Complements
-    :func:`ring_attention` (same math, different collective pattern):
-    ulysses does one all_to_all pair and a fully dense local attention;
-    ring streams K/V blocks around the ICI ring with O(S/P) memory.
-    """
-    ways = mesh.shape[axis]
-    B, S, H, D = q.shape
-    S_kv = k.shape[1]
-    # Cross-length attention (S_q != S_kv) is legal, like ring_attention:
-    # both sequence axes ride the all_to_all, so both must divide.
-    if S % ways or S_kv % ways or H % ways:
-        raise ValueError(
-            f"ulysses_attention needs q seq ({S}), kv seq ({S_kv}) and "
-            f"heads ({H}) divisible by mesh axis {axis!r} ({ways})"
-        )
-    if kv_mask is None:
-        kv_mask = jnp.ones(k.shape[:2], jnp.float32)
-    scale = 1.0 / (D ** 0.5)
-    qspec = P(batch_axis, axis, None, None)
-    mspec = P(batch_axis, axis)
-    fn = jax.shard_map(
-        functools.partial(_ulysses_body, axis=axis, scale=scale),
-        mesh=mesh,
-        in_specs=(qspec, qspec, qspec, mspec),
-        out_specs=qspec,
     )
     return fn(q, k, v, kv_mask)
 

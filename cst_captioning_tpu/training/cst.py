@@ -215,14 +215,34 @@ def _make_one_graph_step(model, cfg, rewarder) -> Callable:
 
 # ----------------------------------------------------------- split variant
 
+def _chunk_count(requested: int, B: int) -> int:
+    """Largest divisor of ``B`` that is <= ``requested`` (>= 1)."""
+    k = max(1, min(requested, B))
+    while B % k:
+        k -= 1
+    return k
+
+
 def _make_split_step(model, cfg, rewarder) -> Callable:
+    """Two-phase CST step for backends without io_callback — with the
+    host scorer pipelined against device compute (SURVEY.md §7 hard part
+    #1: the scorer "must overlap with device compute").
+
+    The rollout is dispatched as K batch chunks, all enqueued before the
+    host blocks: while the device computes chunks c+1..K (and the greedy
+    baseline decode), the host scores chunk c's tokens.  Device idle time
+    during scoring drops from the full scoring cost to ~1/K of it; the
+    math is identical for any K (every chunk samples from the same
+    params — only the rng stream differs from the unchunked dispatch,
+    which K=1 reproduces bit-for-bit)."""
     S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
     need_greedy = baseline_kind == "greedy"
+    k_requested = max(1, getattr(cfg.train, "cst_score_chunks", 1))
 
     @jax.jit
-    def rollout_fn(params, feats, feat_masks, category, rng):
+    def rollout_chunk(params, feats, feat_masks, category, rng):
         feats_r, masks_r, cat_r, _ = _repeat_batch(
             feats, feat_masks, category, jnp.zeros(1, jnp.int32), S
         )
@@ -231,18 +251,22 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             max_len=max_len, greedy=False, temperature=temperature,
             method="sample",
         )
-        if need_greedy:
-            greedy_tokens = model.apply(
-                params, feats, feat_masks, category=category,
-                max_len=max_len, greedy=True, method="sample",
-            ).tokens
-        else:
-            greedy_tokens = jnp.zeros((1, max_len), jnp.int32)
-        return rollout.tokens, rollout.mask, greedy_tokens
+        return rollout.tokens, rollout.mask
+
+    @jax.jit
+    def greedy_chunk(params, feats, feat_masks, category):
+        return model.apply(
+            params, feats, feat_masks, category=category,
+            max_len=max_len, greedy=True, method="sample",
+        ).tokens
 
     @functools.partial(jax.jit, donate_argnums=(0,))
-    def update_fn(state, feats, feat_masks, category, tokens, mask,
-                  advantage):
+    def update_fn(state, feats, feat_masks, category, tokens_chunks,
+                  mask_chunks, advantage):
+        # Chunks concatenate back to the exact _repeat_batch row order
+        # (chunk c holds rows [lo*S, hi*S) of the repeated batch).
+        tokens = jnp.concatenate(tokens_chunks, axis=0)
+        mask = jnp.concatenate(mask_chunks, axis=0)
         feats_r, masks_r, cat_r, _ = _repeat_batch(
             feats, feat_masks, category, jnp.zeros(1, jnp.int32), S
         )
@@ -252,21 +276,67 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
         )
         return state, loss, gnorm
 
+    def _multi_device(x) -> bool:
+        return (
+            isinstance(x, jax.Array) and len(x.sharding.device_set) > 1
+        )
+
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
-        B = np.asarray(video_idx).shape[0]
-        tokens, mask, greedy_tokens = rollout_fn(
-            state.params, feats, feat_masks, category, rng
-        )
         vid = np.asarray(video_idx)
-        vid_r = np.repeat(vid, S, axis=0)
-        rewards = rewarder.score_ids(vid_r, np.asarray(tokens)).astype(
-            np.float32
+        B = vid.shape[0]
+        # Chunk slices ignore any data-axis sharding: on a multi-device
+        # batch each chunk would span a device subset and force per-chunk
+        # resharding — costlier than the scoring overlap saves.  The
+        # split path is the single-chip io_callback workaround; sharded
+        # batches run unchunked.
+        sharded = any(map(_multi_device, feats.values())) or _multi_device(
+            video_idx
         )
+        K = 1 if sharded else _chunk_count(k_requested, B)
+        step = B // K
+        bounds = [(c * step, (c + 1) * step) for c in range(K)]
+
+        def bslice(lo, hi):
+            f = {m: v[lo:hi] for m, v in feats.items()}
+            fm = {m: v[lo:hi] for m, v in feat_masks.items()}
+            cat = category[lo:hi] if category is not None else None
+            return f, fm, cat
+
+        # Phase 1 — enqueue EVERYTHING the scorer will consume before
+        # blocking: K rollout chunks, then the greedy baseline decode
+        # (its compute hides the tail rollout chunks' scoring).
+        dispatched = []
+        for c, (lo, hi) in enumerate(bounds):
+            crng = jax.random.fold_in(rng, c) if K > 1 else rng
+            f, fm, cat = bslice(lo, hi)
+            dispatched.append(rollout_chunk(state.params, f, fm, cat, crng))
+        greedy_parts = (
+            [greedy_chunk(state.params, *bslice(lo, hi)) for lo, hi in bounds]
+            if need_greedy
+            else []
+        )
+
+        # Phase 2 — host scoring, pipelined: np.asarray(chunk c) blocks
+        # only on chunk c's dispatch; later chunks keep the device busy.
+        reward_parts = []
+        for c, (tokens, mask) in enumerate(dispatched):
+            lo, hi = bounds[c]
+            vid_r = np.repeat(vid[lo:hi], S, axis=0)
+            reward_parts.append(
+                rewarder.score_ids(vid_r, np.asarray(tokens)).astype(
+                    np.float32
+                )
+            )
+        rewards = np.concatenate(reward_parts)
+
         if baseline_kind == "greedy":
-            base = rewarder.score_ids(
-                vid, np.asarray(greedy_tokens)
-            ).astype(np.float32)
+            base = np.concatenate([
+                rewarder.score_ids(
+                    vid[lo:hi], np.asarray(toks)
+                ).astype(np.float32)
+                for (lo, hi), toks in zip(bounds, greedy_parts)
+            ])
             baseline = np.repeat(base, S, axis=0)
         elif baseline_kind == "scb":
             r = rewards.reshape(B, S)
@@ -275,8 +345,12 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
         else:
             baseline = np.zeros_like(rewards)
         advantage = rewards - baseline
+
+        # Phase 3 — one PG update over the full batch.
         state, loss, gnorm = update_fn(
-            state, feats, feat_masks, category, tokens, mask,
+            state, feats, feat_masks, category,
+            tuple(t for t, _ in dispatched),
+            tuple(m for _, m in dispatched),
             jnp.asarray(advantage),
         )
         return state, {

@@ -151,6 +151,12 @@ class Trainer:
         self.best_epoch = -1
         self.start_epoch = 0
         self._patience = 0
+        # Mid-epoch preemption bookkeeping: how many of start_epoch's
+        # steps the restored params already contain (those batches are
+        # consumed-but-not-redispatched on replay, so resumed ==
+        # uninterrupted holds even for a mid-epoch eviction).
+        self._resume_skip_steps = 0
+        self._epoch_steps_done = 0
         if cfg.train.resume:
             self._try_resume()
         # False = armed, True = tracing, None = finished/disabled.
@@ -221,7 +227,11 @@ class Trainer:
             log.info("resume requested but no checkpoint at %s — fresh run",
                      last)
             return
-        saved_impl = infos.get("rng_impl")
+        # Checkpoints from before the rng_impl field (rounds 1-2) were all
+        # trained under the then-default threefry2x32 — a missing key must
+        # resume under THAT impl, not whatever the current config default
+        # is, or the replayed stream silently diverges (ADVICE r2 #2).
+        saved_impl = infos.get("rng_impl", "threefry2x32")
         if saved_impl and saved_impl != self.cfg.train.rng_impl:
             # The checkpoint's stream was generated under a different
             # PRNG impl; honor it so the resumed run replays the exact
@@ -234,7 +244,16 @@ class Trainer:
             self.cfg.train.rng_impl = saved_impl
             self._base_rng = self._make_base_rng(saved_impl)
         self.state = ckpt.restore_checkpoint(last, self.state)
-        self.start_epoch = int(infos["epoch"]) + 1
+        if "steps_done" in infos:
+            # Mid-epoch preemption save: params contain the first
+            # ``steps_done`` updates of ``epoch``.  Replay that epoch from
+            # the next step — per-(epoch, step) fold-in RNG and the
+            # deterministic per-epoch batch order make the continuation
+            # bit-identical to an uninterrupted run.
+            self.start_epoch = int(infos["epoch"])
+            self._resume_skip_steps = int(infos["steps_done"])
+        else:
+            self.start_epoch = int(infos["epoch"]) + 1
         bs = infos.get("best_score")
         self.best_score = -np.inf if bs is None else float(bs)
         self.best_epoch = int(infos.get("best_epoch", -1))
@@ -326,7 +345,14 @@ class Trainer:
             log.info("profiler trace written to %s", self.cfg.train.profile_dir)
 
     # ------------------------------------------------------------ training
-    def train_epoch(self, epoch: int, stop_flag=None) -> Dict[str, float]:
+    def train_epoch(
+        self, epoch: int, stop_flag=None, skip_steps: int = 0
+    ) -> Dict[str, float]:
+        """One epoch.  ``skip_steps`` batches are consumed but not
+        dispatched — mid-epoch preemption resume: the restored params
+        already contain those updates, and replaying the remainder under
+        the same per-(epoch, step) fold-in RNG reproduces the
+        uninterrupted run exactly."""
         cfg = self.cfg
         ss_prob = scheduled_sampling_prob(cfg.model, epoch)
         # Plain XE ignores consensus weights (reference train_mode switch).
@@ -335,23 +361,32 @@ class Trainer:
         # converted once at epoch end.
         acc: Dict[str, List[jax.Array]] = {}
         t0 = time.time()
-        nsteps = 0
+        nsteps = 0  # steps dispatched by THIS call (logging/throughput)
+        self._epoch_steps_done = skip_steps
         epoch_rng = jax.random.fold_in(self._base_rng, epoch)
-        for batch in prefetch_to_device(
-            self.train_iter.epoch(epoch), sharding=self._batch_sharding
+        batches = self.train_iter.epoch(epoch)
+        if skip_steps:
+            # Drop already-applied batches BEFORE the device prefetch so
+            # skipping costs host batch assembly only, not H2D transfer.
+            import itertools
+
+            batches = itertools.islice(batches, skip_steps, None)
+        for i, batch in enumerate(
+            prefetch_to_device(batches, sharding=self._batch_sharding),
+            start=skip_steps,
         ):
             # Poll BEFORE dispatching (a post-signal step would fold an
-            # extra update into state the checkpoint labels as epoch-1,
-            # and would eat into the eviction grace window).
+            # update into state beyond what the checkpoint's steps_done
+            # records, and would eat into the eviction grace window).
             if stop_flag is not None and self._stop_agreed(
-                stop_flag, step=nsteps
+                stop_flag, step=i
             ):
                 log.warning(
                     "preemption: stopping epoch %d before step %d",
-                    epoch, nsteps,
+                    epoch, i,
                 )
                 break
-            step_rng = jax.random.fold_in(epoch_rng, nsteps)
+            step_rng = jax.random.fold_in(epoch_rng, i)
             weights = (
                 batch.weights
                 if use_weights
@@ -370,6 +405,7 @@ class Trainer:
             )
             for k, v in metrics.items():
                 acc.setdefault(k, []).append(v)
+            self._epoch_steps_done = i + 1
             nsteps += 1
             if cfg.train.nan_check:
                 # Debug guard (SURVEY.md §5 "sanitizers"): forces a host
@@ -444,16 +480,28 @@ class Trainer:
         # picks up exactly where the run stopped (SURVEY.md §5).
         guard = PreemptionGuard.install()
         for epoch in range(self.start_epoch, cfg.train.max_epochs):
-            entry = self.train_epoch(epoch, stop_flag=guard)
+            entry = self.train_epoch(
+                epoch,
+                stop_flag=guard,
+                skip_steps=(
+                    self._resume_skip_steps
+                    if epoch == self.start_epoch
+                    else 0
+                ),
+            )
             if self._stop_agreed(guard):
-                # Mark the last COMPLETED epoch: the interrupted epoch
-                # replays in full on resume (per-epoch fold_in RNG makes
-                # the replay deterministic; partial-epoch updates in the
-                # saved params are conservatively re-trained).
+                # Record exactly how far the interrupted epoch got: resume
+                # replays the REMAINDER of this epoch (skipping the
+                # steps_done batches already folded into params), so the
+                # continuation is bit-identical to an uninterrupted run.
                 ckpt.save_checkpoint(
                     os.path.join(self.workdir, "last"),
                     self.state,
-                    self._last_extra(epoch - 1, preempted_during=epoch),
+                    self._last_extra(
+                        epoch,
+                        preempted_during=epoch,
+                        steps_done=self._epoch_steps_done,
+                    ),
                 )
                 self.preempted = True
                 log.warning(

@@ -123,7 +123,9 @@ class TestSplitStep:
         )
 
         ds, _ = corpus
-        cfg = cst_cfg(tmp_path, baseline)
+        # chunks=1: the split rollout must replay the one-graph rollout's
+        # exact rng stream (chunked dispatch folds rng per chunk).
+        cfg = cst_cfg(tmp_path, baseline, cst_score_chunks=1)
         cfg.model.vocab_size = len(ds.vocab)
         model = model_from_config(cfg)
         it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
@@ -155,6 +157,68 @@ class TestSplitStep:
             s1.params,
             s2.params,
         )
+
+    @pytest.mark.parametrize("baseline", ["greedy", "scb"])
+    @pytest.mark.parametrize("chunks", [2, 4])
+    def test_chunked_scoring_pipeline_is_exact(
+        self, corpus, tmp_path, baseline, chunks
+    ):
+        """The overlapped K-chunk scoring pipeline (VERDICT r2 #2) must
+        not change the step's math: at near-zero sampling temperature the
+        rollout is deterministic regardless of rng, so K=1 and K>1 must
+        produce identical updates."""
+        from cst_captioning_tpu.data import BatchIterator
+        from cst_captioning_tpu.models import model_from_config
+        from cst_captioning_tpu.training.cst import _make_split_step
+        from cst_captioning_tpu.training.rewards import CiderDRewarder
+        from cst_captioning_tpu.training.steps import (
+            create_train_state,
+            make_optimizer,
+        )
+
+        ds, _ = corpus
+        cfg = cst_cfg(tmp_path, baseline, sample_temperature=1e-4)
+        cfg.model.vocab_size = len(ds.vocab)
+        model = model_from_config(cfg)
+        it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
+                           shuffle=False)
+        batch = next(iter(it.epoch(0)))
+        tx = make_optimizer(cfg.train, 10)
+        rewarder = CiderDRewarder(ds)
+        rng = jax.random.PRNGKey(3)
+
+        def run(k):
+            cfg.train.cst_score_chunks = k
+            state = create_train_state(
+                jax.random.PRNGKey(0), model, tx, batch._asdict()
+            )
+            return _make_split_step(model, cfg, rewarder)(
+                state, batch.feats, batch.feat_masks, batch.captions,
+                batch.weights, None, batch.video_idx, rng, 0.0,
+            )
+
+        s1, m1 = run(1)
+        sk, mk = run(chunks)
+        for key in ("loss", "reward", "baseline"):
+            np.testing.assert_allclose(
+                float(m1[key]), float(mk[key]), rtol=1e-5, atol=1e-7
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            s1.params,
+            sk.params,
+        )
+
+    def test_chunk_count_divisor_fallback(self):
+        from cst_captioning_tpu.training.cst import _chunk_count
+
+        assert _chunk_count(4, 8) == 4
+        assert _chunk_count(4, 6) == 3   # largest divisor <= 4
+        assert _chunk_count(3, 7) == 1   # prime batch
+        assert _chunk_count(1, 64) == 1
+        assert _chunk_count(16, 4) == 4  # capped at B
 
     def test_probe_runs(self):
         from cst_captioning_tpu.training.cst import io_callback_supported

@@ -85,12 +85,15 @@ def test_sigterm_checkpoints_and_resumes(tmp_path):
 
     infos = load_infos(os.path.join(workdir, "last"))
     assert "preempted_during" in infos
+    assert "steps_done" in infos  # mid-epoch position recorded
     cfg = get_preset("synthetic_smoke")
     cfg.train.checkpoint_dir = os.path.join(str(tmp_path), "ck2")
     cfg.train.max_epochs = int(infos["epoch"]) + 2
     cfg.train.resume = True
     ds, _ = make_synthetic_dataset(num_videos=16, max_frames=6)
     t = Trainer(cfg, train_ds=ds, val_ds=None, workdir=workdir)
-    assert t.start_epoch == int(infos["epoch"]) + 1
+    # Resume replays the REMAINDER of the interrupted epoch.
+    assert t.start_epoch == int(infos["epoch"])
+    assert t._resume_skip_steps == int(infos["steps_done"])
     hist = t.fit()
     assert any(np.isfinite(e["train_loss"]) for e in hist.values())

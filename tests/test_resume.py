@@ -62,6 +62,67 @@ class TestResume:
             hist_c["3"]["train_loss"], hist_a["3"]["train_loss"], rtol=1e-6
         )
 
+    def test_midepoch_preemption_resume_matches_uninterrupted(
+        self, ds, tmp_path, monkeypatch
+    ):
+        """A preemption that lands MID-epoch must still resume to the
+        exact uninterrupted result: the checkpoint records steps_done and
+        the replay skips exactly those batches (ADVICE r2 #3)."""
+        from cst_captioning_tpu.training.preemption import PreemptionGuard
+
+        def mk(name, max_epochs, resume=False):
+            # batch 8 over 16 videos -> 2 steps/epoch (and divisible by
+            # the conftest's 8-device data axis).
+            return cfg_for(tmp_path, name, max_epochs, resume=resume)
+
+        ta = Trainer(mk("mid_full", 3), train_ds=ds, val_ds=None)
+        ta.fit()
+
+        class FlagAfter:
+            """Latches True after n polls — deterministically lands the
+            'signal' between two specific step dispatches."""
+
+            def __init__(self, n):
+                self.n = n
+                self.reads = 0
+
+            @property
+            def triggered(self):
+                self.reads += 1
+                return self.reads > self.n
+
+        # Polls: 2 per epoch (one per batch) + 1 at epoch end.  n=4 ->
+        # epoch 0 completes (reads 1-3), epoch 1 breaks before its step 1
+        # (reads 4, 5) with exactly one update applied.
+        fake = FlagAfter(4)
+        monkeypatch.setattr(
+            PreemptionGuard, "install", classmethod(lambda cls: fake)
+        )
+        tb = Trainer(mk("mid_halves", 3), train_ds=ds, val_ds=None)
+        tb.fit()
+        assert tb.preempted
+        monkeypatch.undo()
+
+        from cst_captioning_tpu.training.checkpoint import load_infos
+
+        infos = load_infos(os.path.join(tb.workdir, "last"))
+        assert int(infos["epoch"]) == 1
+        assert int(infos["steps_done"]) == 1
+
+        tc = Trainer(
+            mk("mid_halves", 3, resume=True), train_ds=ds, val_ds=None
+        )
+        assert tc.start_epoch == 1 and tc._resume_skip_steps == 1
+        tc.fit()
+        assert int(tc.state.step) == int(ta.state.step)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7
+            ),
+            ta.state.params,
+            tc.state.params,
+        )
+
     def test_resume_without_checkpoint_is_fresh(self, ds, tmp_path):
         cfg = cfg_for(tmp_path, "fresh", 1, resume=True)
         t = Trainer(cfg, train_ds=ds, val_ds=None)

@@ -10,7 +10,6 @@ from cst_captioning_tpu.parallel import make_mesh
 from cst_captioning_tpu.parallel.ring import (
     ring_attention,
     sharded_context_attention,
-    ulysses_attention,
 )
 
 
@@ -71,89 +70,6 @@ class TestRingAttention:
         ref = dense_attention(q, k, v, jnp.ones((B, S)))
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-6
-        )
-
-
-class TestUlyssesAttention:
-    """All-to-all sequence parallelism: exact vs dense multi-head
-    attention on the 8-device mesh."""
-
-    @staticmethod
-    def dense_mha(q, k, v, mask):
-        scale = 1.0 / (q.shape[-1] ** 0.5)
-        s = jnp.einsum("bqhd,bkhd->bhqk", q * scale, k)
-        s = jnp.where(mask[:, None, None, :] > 0, s, -1e30)
-        a = jax.nn.softmax(s, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", a, v)
-
-    def test_matches_dense(self, mesh):
-        rng = np.random.RandomState(10)
-        B, S, H, D = 2, 64, 8, 16
-        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        mask = jnp.asarray(rng.rand(B, S) > 0.25, jnp.float32)
-        ref = self.dense_mha(q, k, v, mask)
-        got = ulysses_attention(q, k, v, mesh, kv_mask=mask)
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6
-        )
-
-    def test_matches_ring_per_head(self, mesh):
-        """Same math as ring attention head-by-head (two SP layouts,
-        one result)."""
-        rng = np.random.RandomState(11)
-        B, S, H, D = 2, 64, 8, 16
-        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        got = ulysses_attention(q, k, v, mesh)
-        per_head = jnp.stack(
-            [
-                ring_attention(q[:, :, h], k[:, :, h], v[:, :, h], mesh)
-                for h in range(H)
-            ],
-            axis=2,
-        )
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(per_head), rtol=2e-5, atol=2e-6
-        )
-
-    def test_divisibility_guard(self, mesh):
-        q = jnp.zeros((2, 64, 6, 16))  # 6 heads not divisible by 8
-        with pytest.raises(ValueError, match="divisible"):
-            ulysses_attention(q, q, q, mesh)
-        qq = jnp.zeros((2, 64, 8, 16))
-        kk = jnp.zeros((2, 60, 8, 16))  # kv seq not divisible by 8
-        with pytest.raises(ValueError, match="divisible"):
-            ulysses_attention(qq, kk, kk, mesh)
-
-    def test_cross_length(self, mesh):
-        """S_q != S_kv (legal, like ring); default mask follows k."""
-        rng = np.random.RandomState(13)
-        B, Sq, Skv, H, D = 2, 32, 64, 8, 16
-        q = jnp.asarray(rng.randn(B, Sq, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(B, Skv, H, D), jnp.float32)
-        v = jnp.asarray(rng.randn(B, Skv, H, D), jnp.float32)
-        ref = self.dense_mha(q, k, v, jnp.ones((B, Skv)))
-        got = ulysses_attention(q, k, v, mesh)
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6
-        )
-
-    def test_composes_with_batch_axis(self):
-        mesh2 = make_mesh({"data": 2, "model": 4})
-        rng = np.random.RandomState(12)
-        B, S, H, D = 4, 32, 4, 8
-        q = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        k = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        v = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
-        ref = self.dense_mha(q, k, v, jnp.ones((B, S)))
-        got = ulysses_attention(
-            q, k, v, mesh2, batch_axis="data"
-        )
-        np.testing.assert_allclose(
-            np.asarray(got), np.asarray(ref), rtol=2e-5, atol=2e-6
         )
 
 
