@@ -7,27 +7,32 @@ JRE, so this module provides:
 * :class:`MeteorJava` — the subprocess path, used automatically when a JRE
   and jar are available (API-compatible with the reference's wrapper).
 * :class:`MeteorLite` — a documented pure-Python port of the METEOR
-  algorithm with the *exact*, *stem* (Porter) and — when a synonym table
-  is supplied — *synonym* matcher stages, METEOR-1.5 English alpha/gamma
-  (0.85/0.6) and the classic fragmentation exponent 3.0.  Golden tests
-  (`tests/test_metrics.py::TestMeteorGolden`) pin the math to
-  hand-computed values.
+  algorithm with *exact*, *synonym* and *stem* (Porter) matchers,
+  METEOR-1.5 English alpha/gamma (0.85/0.6) and the classic
+  fragmentation exponent 3.0.  Alignment is a BEAM SEARCH over
+  one-to-one word alignments maximizing (match count, weighted matches,
+  -chunk count) — the jar's own alignment objective — not a greedy
+  heuristic; adversarial cases where greedy left-to-right matching picks
+  a chunk-suboptimal alignment are pinned in
+  ``tests/test_metrics.py::TestMeteorAlignment``.
 
-**Quantified delta vs the jar** (no jar/JRE in this environment to diff
-against, so the bound is analytic): the lite score is monotonically
-non-decreasing in per-word match weight, and each matcher stage only adds
-matches, so dropping the synonym (w=0.8) and paraphrase (w=0.6) stages can
-only *lower* precision/recall — lite METEOR is a lower bound of jar
-METEOR up to the fragmentation-exponent difference.  A token that the jar
-matches via synonymy but lite leaves unmatched shifts that segment's
-weighted P/R by at most 0.8/len; e.g. if 5% of tokens are synonym-only
-matches, the corpus-level deficit is bounded by ~0.04·fmean — a few
-METEOR points.  Every ``language_eval`` result carries a
-``METEOR_backend`` stamp so jar- and lite-scored runs are never conflated.
+**Validation without a jar** (no JRE in this environment to diff
+against): (1) the scoring constants are constructor parameters, and
+``TestMeteorGolden`` checks the published worked examples of the METEOR
+paper (Banerjee & Lavie 2005, §3.1) under THAT paper's constants
+(alpha=0.9, gamma=0.5, beta=3) — goldens external to this
+implementation; (2) the remaining jar delta is the matcher data:
+the vendored synonym table (``data/meteor_synonyms_en.json``, a
+caption-domain subset) is far smaller than WordNet, and METEOR-1.5's
+tuned function-word weighting (delta) is not implemented.  A token the
+jar matches via synonymy but lite leaves unmatched shifts that
+segment's weighted P/R by at most 0.8/len.  Every ``language_eval``
+result carries a ``METEOR_backend`` stamp so jar- and lite-scored runs
+are never conflated.
 
-The synonym stage loads an external word -> synonym-words table
-(``METEOR_SYNONYMS`` env var, json) — the data is externalized exactly
-like the jar itself; WordNet's data files are not in this image.
+The synonym matcher loads the vendored table by default; override with
+the ``METEOR_SYNONYMS`` env var (a {word: [synonyms...]} json), or set
+it to ``none`` to disable the stage.
 
 :class:`Meteor` picks the best available backend.
 """
@@ -57,15 +62,24 @@ W_STEM = 0.6
 W_SYN = 0.8
 
 METEOR_SYNONYMS_ENV = "METEOR_SYNONYMS"
+# Vendored caption-domain synonym table, loaded when the env var is unset.
+DEFAULT_SYNONYMS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "data",
+    "meteor_synonyms_en.json",
+)
 
 
 def load_synonyms(path: str) -> Dict[str, frozenset]:
     """Load a {word: [synonym words...]} json into a symmetric lookup:
-    word -> frozenset of words it may match at the synonym stage."""
+    word -> frozenset of words it may match at the synonym stage.
+    Keys starting with ``_`` are metadata (e.g. ``_comment``), skipped."""
     with open(path) as f:
         raw = json.load(f)
     table: Dict[str, set] = {}
     for w, syns in raw.items():
+        if w.startswith("_"):
+            continue
         for s in syns:
             table.setdefault(w, set()).add(s)
             table.setdefault(s, set()).add(w)
@@ -74,107 +88,139 @@ def load_synonyms(path: str) -> Dict[str, frozenset]:
 
 # ------------------------------------------------------------------ alignment
 
+# Beam width for the alignment search.  On <=30-token captions with few
+# duplicate words the beam is effectively exhaustive; the jar uses the
+# same construction (beam search over one-to-one alignments).
+ALIGN_BEAM = 64
+
+
+def _pair_weight(hw, rw, hs, rs, synonyms) -> float:
+    """Best matcher weight for a (hyp word, ref word) pair, or 0.
+    Priority exact (1.0) > synonym (0.8) > stem (0.6) — a
+    surface-identical pair is always an exact match, never a synonym one
+    (per-pair max over matchers, the METEOR 1.3+ formulation)."""
+    if hw == rw:
+        return W_EXACT
+    if synonyms is not None and rw in synonyms.get(hw, ()):
+        return W_SYN
+    if hs == rs:
+        return W_STEM
+    return 0.0
+
+
 def _align(
     hyp: List[str],
     ref: List[str],
     synonyms: Optional[Dict[str, frozenset]] = None,
+    beam: int = ALIGN_BEAM,
 ) -> Tuple[float, float, int, int]:
     """Align hypothesis to one reference.
 
-    Returns (weighted_matches_hyp, weighted_matches_ref, n_matches, n_chunks).
-    Stage 1 matches exact surface forms, stage 2 Porter stems, stage 3
-    (when a table is loaded) synonym sets — each one-to-one and greedy
-    left-to-right with a continuation preference that approximately
-    minimizes chunk count (the jar solves this exactly via beam search; on
-    <=30-token captions the greedy solution almost always coincides).
+    Returns (weighted_matches_hyp, weighted_matches_ref, n_matches,
+    n_chunks).  Beam search over one-to-one alignments, hyp position by
+    hyp position; objective (lexicographic, the jar's): maximize match
+    count, then total matcher weight, then MINIMIZE chunk count.  A
+    chunk is a run of consecutive hyp positions mapped to consecutive
+    ref positions; an unmatched hyp word breaks the run.
     """
     hyp_stem = [porter_stem(w) for w in hyp]
     ref_stem = [porter_stem(w) for w in ref]
-    match_ref_idx = [-1] * len(hyp)   # hyp position -> ref position
-    match_w = [0.0] * len(hyp)
-    used_ref = [False] * len(ref)
+    cands: List[List[Tuple[int, float]]] = []
+    for i, hw in enumerate(hyp):
+        row = []
+        for j, rw in enumerate(ref):
+            w = _pair_weight(hw, rw, hyp_stem[i], ref_stem[j], synonyms)
+            if w > 0.0:
+                row.append((j, w))
+        cands.append(row)
 
-    def syn_match(hw: str, rw: str) -> bool:
-        if hw == rw:
-            return True
-        s = synonyms.get(hw)
-        return s is not None and rw in s
+    def rank(v):
+        m, ws, ch = v
+        return (m, ws, -ch)
 
-    stages = [
-        (W_EXACT, hyp, ref, None),
-        (W_STEM, hyp_stem, ref_stem, None),
-    ]
-    if synonyms:
-        stages.append((W_SYN, hyp, ref, syn_match))
-    for weight, h_toks, r_toks, match in stages:
-        for i, hw in enumerate(h_toks):
-            if match_ref_idx[i] >= 0:
-                continue
-            # candidate ref positions for this word
-            cands = [
-                j
-                for j, rw in enumerate(r_toks)
-                if not used_ref[j]
-                and (match(hw, rw) if match else rw == hw)
-            ]
-            if not cands:
-                continue
-            # prefer the position that continues the previous match's chunk
-            prev = match_ref_idx[i - 1] if i > 0 else -2
-            cont = [j for j in cands if j == prev + 1]
-            j = cont[0] if cont else cands[0]
-            match_ref_idx[i] = j
-            match_w[i] = weight
-            used_ref[j] = True
+    # state: (used_ref_bitmask, last_matched_ref_j) -> (matches, wsum, chunks)
+    states = {(0, -2): (0, 0.0, 0)}
+    for i in range(len(hyp)):
+        new: Dict[Tuple[int, int], Tuple[int, float, int]] = {}
 
-    n_matches = sum(1 for j in match_ref_idx if j >= 0)
-    if n_matches == 0:
+        def offer(key, val):
+            old = new.get(key)
+            if old is None or rank(val) > rank(old):
+                new[key] = val
+
+        for (mask, last_j), (m, ws, ch) in states.items():
+            offer((mask, -2), (m, ws, ch))  # leave hyp[i] unmatched
+            for j, w in cands[i]:
+                if mask >> j & 1:
+                    continue
+                offer(
+                    (mask | (1 << j), j),
+                    (m + 1, ws + w, ch + (0 if j == last_j + 1 else 1)),
+                )
+        if len(new) > beam:
+            new = dict(
+                sorted(new.items(), key=lambda kv: rank(kv[1]),
+                       reverse=True)[:beam]
+            )
+        states = new
+
+    m, ws, ch = max(states.values(), key=rank)
+    if m == 0:
         return 0.0, 0.0, 0, 0
-    # chunk count: runs of consecutive hyp positions mapping to consecutive refs
-    chunks = 0
-    prev_j = -2
-    for j in match_ref_idx:
-        if j < 0:
-            prev_j = -2
-            continue
-        if j != prev_j + 1:
-            chunks += 1
-        prev_j = j
-    wsum = float(sum(match_w))
-    return wsum, wsum, n_matches, chunks
+    return ws, ws, m, ch
 
 
-def _segment_stats(hyp: List[str], refs: List[List[str]], synonyms=None):
+def _segment_stats(hyp: List[str], refs: List[List[str]], synonyms=None,
+                   alpha=ALPHA, gamma=GAMMA, frag_exp=FRAG_EXP):
     """Best-reference METEOR statistics for one segment."""
     best = None
     for ref in refs:
         wm_h, wm_r, m, ch = _align(hyp, ref, synonyms)
         p = wm_h / len(hyp) if hyp else 0.0
         r = wm_r / len(ref) if ref else 0.0
-        score = _score_from(p, r, m, ch)
+        score = _score_from(p, r, m, ch, alpha, gamma, frag_exp)
         stats = (wm_h, wm_r, m, ch, len(hyp), len(ref), score)
         if best is None or score > best[6]:
             best = stats
     return best
 
 
-def _score_from(p: float, r: float, matches: int, chunks: int) -> float:
+def _score_from(p: float, r: float, matches: int, chunks: int,
+                alpha=ALPHA, gamma=GAMMA, frag_exp=FRAG_EXP) -> float:
     if p == 0 or r == 0 or matches == 0:
         return 0.0
-    fmean = p * r / (ALPHA * p + (1 - ALPHA) * r)
+    fmean = p * r / (alpha * p + (1 - alpha) * r)
     frag = chunks / matches
-    penalty = GAMMA * (frag ** FRAG_EXP)
+    penalty = gamma * (frag ** frag_exp)
     return fmean * (1.0 - penalty)
 
 
 class MeteorLite:
-    def __init__(self, synonym_file: Optional[str] = None):
-        synonym_file = synonym_file or os.environ.get(
-            METEOR_SYNONYMS_ENV, ""
+    def __init__(
+        self,
+        synonym_file: Optional[str] = None,
+        alpha: float = ALPHA,
+        gamma: float = GAMMA,
+        frag_exp: float = FRAG_EXP,
+    ):
+        """``synonym_file`` resolution: explicit arg > ``METEOR_SYNONYMS``
+        env var > vendored caption-domain table; the literal ``"none"``
+        disables the synonym matcher.  The scoring constants are
+        parameters so published worked examples under OTHER METEOR
+        versions' constants can serve as external goldens."""
+        synonym_file = (
+            synonym_file
+            or os.environ.get(METEOR_SYNONYMS_ENV, "")
+            or (DEFAULT_SYNONYMS if os.path.exists(DEFAULT_SYNONYMS) else "")
         )
+        if synonym_file == "none":
+            synonym_file = ""
         self.synonyms = (
             load_synonyms(synonym_file) if synonym_file else None
         )
+        self.alpha = alpha
+        self.gamma = gamma
+        self.frag_exp = frag_exp
 
     def compute_score(
         self, gts: Dict[str, List[str]], res: Dict[str, List[str]]
@@ -187,7 +233,8 @@ class MeteorLite:
             hyp = res[k][0].split()
             refs = [r.split() for r in gts[k]]
             wm_h, wm_r, m, ch, lh, lr, score = _segment_stats(
-                hyp, refs, self.synonyms
+                hyp, refs, self.synonyms,
+                self.alpha, self.gamma, self.frag_exp,
             )
             seg_scores.append(score)
             agg += np.array([wm_h, wm_r, m, ch, lh, lr])
@@ -195,7 +242,8 @@ class MeteorLite:
         wm_h, wm_r, m, ch, lh, lr = agg
         p = wm_h / lh if lh else 0.0
         r = wm_r / lr if lr else 0.0
-        corpus = _score_from(p, r, int(m), int(ch))
+        corpus = _score_from(p, r, int(m), int(ch),
+                             self.alpha, self.gamma, self.frag_exp)
         return float(corpus), np.array(seg_scores)
 
 

@@ -558,11 +558,18 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         # fallback would otherwise still reach the kernel.
         import logging
 
+        hint = (
+            "the sharded-fusion (shard_frames) path is active; the "
+            "kernel would only have been reached by the non-divisible-"
+            "frames dense fallback"
+            if shard_frames
+            else "set model.shard_frames for sharded fusion"
+        )
         logging.getLogger("cst_captioning_tpu.models").warning(
             "use_pallas_attention disabled: the fused kernel has no SPMD "
             "partitioning rule for the %d-device mesh — using the dense "
-            "attention math (set model.shard_frames for sharded fusion)",
-            mesh.devices.size,
+            "attention math (%s)",
+            mesh.devices.size, hint,
         )
         use_pallas_attention = False
     return CaptionModel(

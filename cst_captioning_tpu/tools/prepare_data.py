@@ -79,23 +79,38 @@ def load_annotations(path: str, fmt: str) -> Tuple[
 
 def consensus_weights(
     tokenized: Sequence[Sequence[str]],
+    df=None,
+    log_ref_len: float = None,
     normalize: bool = True,
 ) -> np.ndarray:
     """CIDEr-D of each caption vs its siblings (leave-one-out), the paper's
     WXE consensus score.  ``normalize`` rescales to mean 1.0 per video so
-    WXE keeps the same overall loss scale as XE."""
+    WXE keeps the same overall loss scale as XE.
+
+    ``df``/``log_ref_len``: CORPUS-level document frequencies (one
+    document per video's reference set), as standard CIDEr uses and the
+    reference's precomputed df pickle implies.  Falling back to
+    per-video df (each sibling its own document) when omitted is kept
+    for lone-video corpora only — per-video df INVERTS the weighting on
+    corpora with a corpus-wide generic caption: within one video the
+    generic block's n-grams look rare-ish (df = #generic of 20) and its
+    members validate each other, so the generic refs get the HIGHEST
+    weight, while under corpus df (df = every video) they get ~0.  The
+    round-3 rehearsal corpus demonstrated exactly this failure: WXE
+    with per-video-df weights collapsed val CIDEr to 0 by amplifying
+    the generic caption it is meant to suppress.
+    """
     cooked = [precook(t) for t in tokenized]
     n = len(cooked)
     if n < 2:
         return np.ones((n,), np.float32)
-    # Per-video idf: each sibling caption is its own document, so n-grams
-    # shared by many siblings (stopwords) get lower idf weight.
-    df = compute_doc_freq([[c] for c in cooked])
-    log_ref = math.log(max(float(n), 2.0))
+    if df is None:
+        df = compute_doc_freq([[c] for c in cooked])
+        log_ref_len = math.log(max(float(n), 2.0))
     w = np.array(
         [
             ciderd_score_cooked(
-                cooked[i], cooked[:i] + cooked[i + 1 :], df, log_ref
+                cooked[i], cooked[:i] + cooked[i + 1 :], df, log_ref_len
             )
             for i in range(n)
         ],
@@ -216,8 +231,19 @@ def prepare(
             )
             for vid in vids
         }
+        # Consensus weights under the SPLIT's corpus document
+        # frequencies (one document per video's reference set) — the
+        # standard-CIDEr df the paper's consensus score implies.  For
+        # the train split this is the same corpus as the idf table.
+        split_df = compute_doc_freq(
+            [[precook(t) for t in tokenized[vid]] for vid in vids]
+        )
+        split_log_ref = math.log(max(float(len(vids)), 2.0))
         weights = {
-            vid: consensus_weights(tokenized[vid]) for vid in vids
+            vid: consensus_weights(
+                tokenized[vid], df=split_df, log_ref_len=split_log_ref
+            )
+            for vid in vids
         }
         refs = {vid: captions[vid] for vid in vids}
         lab = os.path.join(out_dir, f"labels_{split}.h5")

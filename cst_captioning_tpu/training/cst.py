@@ -123,11 +123,17 @@ def _pg_update(state, feats_r, masks_r, cat_r, tokens, mask, advantage,
     return state, loss, gnorm
 
 
-def make_cst_train_step(model: CaptionModel, cfg, train_ds) -> Callable:
+def make_cst_train_step(
+    model: CaptionModel, cfg, train_ds, mesh=None
+) -> Callable:
     """Build the CST step.  Same signature as the XE step (``trainer.py``
     dispatch): ``(state, feats, feat_masks, captions, weights, category,
     video_idx, rng, ss_prob) -> (state, metrics)``; ``captions`` /
-    ``weights`` / ``ss_prob`` are unused (sampling-based regime)."""
+    ``weights`` / ``ss_prob`` are unused (sampling-based regime).
+
+    ``mesh``: the trainer's device mesh, if any — the one-graph step then
+    shards the reward io_callback over the data axis instead of letting
+    SPMD funnel every crossing through device 0."""
     if cfg.train.cst_use_gt:
         # CST_GT_None: the "samples" are the GT captions weighted by their
         # consensus scores — no rollout, mathematically the WXE regime
@@ -142,7 +148,7 @@ def make_cst_train_step(model: CaptionModel, cfg, train_ds) -> Callable:
         weighted_refs=cfg.train.cst_weighted_reward,
     )
     if io_callback_supported():
-        return _make_one_graph_step(model, cfg, rewarder)
+        return _make_one_graph_step(model, cfg, rewarder, mesh=mesh)
     log.warning(
         "backend lacks io_callback support — using the split CST step "
         "(jitted rollout / host scoring / jitted update)"
@@ -152,7 +158,7 @@ def make_cst_train_step(model: CaptionModel, cfg, train_ds) -> Callable:
 
 # ------------------------------------------------------- one-graph variant
 
-def _make_one_graph_step(model, cfg, rewarder) -> Callable:
+def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
     S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
@@ -160,13 +166,55 @@ def _make_one_graph_step(model, cfg, rewarder) -> Callable:
     def host_score(video_idx, tokens):
         return rewarder.score_ids(video_idx, tokens).astype(np.float32)
 
-    def score(video_idx, tokens):
-        return io_callback(
-            host_score,
-            jax.ShapeDtypeStruct((tokens.shape[0],), jnp.float32),
-            video_idx,
-            tokens,
+    if mesh is not None and mesh.shape.get("data", 1) > 1:
+        # Sharded reward crossing (VERDICT r2 #3): an unannotated
+        # io_callback compiles to a {maximal device=0} sharding, and SPMD
+        # replicates-then-repartitions around it every step ("Involuntary
+        # full rematerialization").  Scoring is per-row, so run the
+        # callback INSIDE shard_map: each shard scores its own rows and
+        # the results are born with the batch sharding.  When the row
+        # count also divides the model axis, rows split over BOTH axes —
+        # otherwise model-axis replicas would each re-invoke the host
+        # scorer on the same rows (host scoring is hot loop #2,
+        # SURVEY.md §3).
+        from jax.sharding import PartitionSpec as P
+
+        other_axes = tuple(
+            a for a, n in mesh.shape.items() if a != "data" and n > 1
         )
+        other_ways = int(np.prod([mesh.shape[a] for a in other_axes] or [1]))
+        data_ways = mesh.shape["data"]
+
+        def score(video_idx, tokens):
+            rows = tokens.shape[0]
+            axes = (
+                ("data",) + other_axes
+                if other_axes and rows % (data_ways * other_ways) == 0
+                else ("data",)
+            )
+
+            def body(vi, tk):
+                return io_callback(
+                    host_score,
+                    jax.ShapeDtypeStruct((tk.shape[0],), jnp.float32),
+                    vi,
+                    tk,
+                )
+
+            return jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(axes), P(axes, None)),
+                out_specs=P(axes),
+            )(video_idx, tokens)
+    else:
+        def score(video_idx, tokens):
+            return io_callback(
+                host_score,
+                jax.ShapeDtypeStruct((tokens.shape[0],), jnp.float32),
+                video_idx,
+                tokens,
+            )
 
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):

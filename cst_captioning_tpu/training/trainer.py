@@ -275,7 +275,7 @@ class Trainer:
             from cst_captioning_tpu.training.cst import make_cst_train_step
 
             self._train_step = make_cst_train_step(
-                self.model, self.cfg, self.train_ds
+                self.model, self.cfg, self.train_ds, mesh=self.mesh
             )
         else:
             raise ValueError(f"unknown train_mode {mode!r}")

@@ -226,6 +226,74 @@ class TestSplitStep:
         assert io_callback_supported() is True  # CPU supports it
 
 
+class TestShardedRewardCallback:
+    """One-graph step with a data-sharded reward io_callback (the
+    anti-involuntary-remat construction) must match the unannotated
+    callback bit-for-bit."""
+
+    @pytest.mark.parametrize("baseline", ["greedy", "scb"])
+    def test_sharded_callback_matches_unsharded(
+        self, corpus, tmp_path, baseline
+    ):
+        from cst_captioning_tpu.data import BatchIterator
+        from cst_captioning_tpu.models import model_from_config
+        from cst_captioning_tpu.parallel import (
+            batch_sharding,
+            make_mesh,
+            shard_batch,
+        )
+        from cst_captioning_tpu.training.cst import _make_one_graph_step
+        from cst_captioning_tpu.training.rewards import CiderDRewarder
+        from cst_captioning_tpu.training.steps import (
+            create_train_state,
+            make_optimizer,
+        )
+
+        ds, _ = corpus
+        cfg = cst_cfg(tmp_path, baseline)
+        cfg.model.vocab_size = len(ds.vocab)
+        mesh = make_mesh({"data": 4, "model": 2})
+        model = model_from_config(cfg)
+        it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
+                           shuffle=False)
+        batch = next(iter(it.epoch(0)))
+        tx = make_optimizer(cfg.train, 10)
+        rewarder = CiderDRewarder(ds)
+        rng = jax.random.PRNGKey(3)
+        sh = batch_sharding(mesh)
+
+        def run(step_mesh):
+            state = create_train_state(
+                jax.random.PRNGKey(0), model, tx, batch._asdict()
+            )
+            step = _make_one_graph_step(model, cfg, rewarder,
+                                        mesh=step_mesh)
+            return step(
+                state,
+                shard_batch(batch.feats, mesh),
+                shard_batch(batch.feat_masks, mesh),
+                jax.device_put(batch.captions, sh),
+                jax.device_put(batch.weights, sh),
+                None,
+                jax.device_put(batch.video_idx, sh),
+                rng, 0.0,
+            )
+
+        s_plain, m_plain = run(None)
+        s_shard, m_shard = run(mesh)
+        for k in ("loss", "reward", "baseline"):
+            np.testing.assert_allclose(
+                float(m_plain[k]), float(m_shard[k]), rtol=1e-5, atol=1e-7
+            )
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+            ),
+            s_plain.params,
+            s_shard.params,
+        )
+
+
 class TestCSTTraining:
     @pytest.mark.parametrize("baseline", ["greedy", "scb", "none"])
     def test_step_runs_and_reports_reward(self, corpus, tmp_path, baseline):

@@ -229,11 +229,49 @@ class TestMeteorGolden:
         # symmetric closure: the table entry works in either direction
         s2, _ = m.compute_score({"a": ["a feline"]}, {"a": ["a cat"]})
         assert s2 == pytest.approx(s, rel=1e-9)
-        # without the table the synonym token goes unmatched
-        s_no, _ = MeteorLite().compute_score(
+        # with the synonym matcher disabled the token goes unmatched
+        # (the VENDORED default table also knows cat~feline, so the
+        # control must disable the stage, not just drop the custom file)
+        s_no, _ = MeteorLite(synonym_file="none").compute_score(
             {"a": ["a cat"]}, {"a": ["a feline"]}
         )
         assert s_no < s
+        # ... and the vendored default table matches it out of the box
+        s_default, _ = MeteorLite().compute_score(
+            {"a": ["a cat"]}, {"a": ["a feline"]}
+        )
+        assert s_default == pytest.approx(s, rel=1e-9)
+
+    def test_banerjee_lavie_2005_worked_example(self):
+        """External golden: the chunk-penalty worked example of the
+        METEOR paper (Banerjee & Lavie 2005, §3.1) under THAT paper's
+        constants (Fmean = 10PR/(R+9P) i.e. alpha=0.9; penalty =
+        0.5*(chunks/matches)^3).  hyp 'the president spoke to the
+        audience' vs ref 'the president then spoke to the audience':
+        6 matches in 2 chunks ('the president' / 'spoke to the
+        audience')."""
+        m = MeteorLite(synonym_file="none", alpha=0.9, gamma=0.5,
+                       frag_exp=3.0)
+        p, r = 6 / 6, 6 / 7
+        fmean = 10 * p * r / (r + 9 * p)            # = 60/69
+        expect = fmean * (1 - 0.5 * (2 / 6) ** 3)
+        s, _ = m.compute_score(
+            {"a": ["the president then spoke to the audience"]},
+            {"a": ["the president spoke to the audience"]},
+        )
+        assert s == pytest.approx(expect, rel=1e-9)
+
+    def test_banerjee_lavie_2005_identity(self):
+        """External golden: identical sentences align as ONE chunk, so
+        the 2005 penalty is 0.5*(1/6)^3 — the paper's 'as the number of
+        chunks goes to 1 the penalty vanishes' behavior."""
+        m = MeteorLite(synonym_file="none", alpha=0.9, gamma=0.5,
+                       frag_exp=3.0)
+        s, _ = m.compute_score(
+            {"a": ["the president spoke to the audience"]},
+            {"a": ["the president spoke to the audience"]},
+        )
+        assert s == pytest.approx(1 - 0.5 * (1 / 6) ** 3, rel=1e-9)
 
     def test_corpus_aggregation(self):
         # Corpus score recomputes from summed statistics, not mean of
@@ -247,6 +285,60 @@ class TestMeteorGolden:
         s, seg = m.compute_score(gts, res)
         assert s == pytest.approx(expect, rel=1e-9)
         assert len(seg) == 2
+
+
+class TestMeteorAlignment:
+    """The alignment is a beam search minimizing chunks among
+    max-match alignments (the jar's objective) — these are the
+    adversarial cases where greedy left-to-right matching picks a
+    chunk-suboptimal alignment (VERDICT r2 #4)."""
+
+    def test_duplicate_word_prefers_chunk_minimal_slot(self):
+        from cst_captioning_tpu.metrics.meteor import _align
+
+        # hyp 'a b' vs ref 'a x a b': greedy binds hyp 'a' to ref[0]
+        # (2 chunks); the optimum binds it to ref[2] -> ONE chunk.
+        wm_h, wm_r, m, ch = _align(["a", "b"], ["a", "x", "a", "b"])
+        assert (m, ch) == (2, 1)
+        assert wm_h == pytest.approx(2.0)
+
+    def test_never_trades_a_match_for_a_chunk(self):
+        from cst_captioning_tpu.metrics.meteor import _align
+
+        # Dropping hyp 'a' would leave one perfect chunk, but matches
+        # dominate chunks lexicographically.
+        wm_h, _, m, ch = _align(["a", "b"], ["b", "q", "r", "s", "a"])
+        assert (m, ch) == (2, 2)
+
+    def test_crossing_alignment_counts_chunks(self):
+        from cst_captioning_tpu.metrics.meteor import _align
+
+        # 'a b c' vs 'b c x a': best is a->3 (chunk), b,c->0,1 (chunk).
+        _, _, m, ch = _align(["a", "b", "c"], ["b", "c", "x", "a"])
+        assert (m, ch) == (3, 2)
+
+    def test_stem_and_exact_compete_for_one_slot(self):
+        from cst_captioning_tpu.metrics.meteor import _align
+
+        # ref has ONE 'run' slot; hyp 'run running': exact pair gets the
+        # surface slot, the other hyp word stem-matches nothing else ->
+        # weight must be 1.0 + 0 (not 0.6 + ...): total m=1.
+        wm_h, _, m, ch = _align(["run"], ["running"])
+        assert m == 1 and wm_h == pytest.approx(0.6)  # stem-only pair
+        wm_h2, _, m2, _ = _align(["run", "running"], ["running", "run"])
+        # both surface forms present: two EXACT matches (w=1 each),
+        # beam must not settle for stem pairings
+        assert m2 == 2 and wm_h2 == pytest.approx(2.0)
+
+    def test_surface_equal_pair_never_scores_as_synonym(self):
+        from cst_captioning_tpu.metrics.meteor import _align
+
+        # ADVICE r2 #5: with a synonym table containing the word itself,
+        # a surface-identical residual pair must weigh W_EXACT, not
+        # W_SYN.
+        syn = {"cat": frozenset({"cat", "feline"})}
+        wm_h, _, m, _ = _align(["cat"], ["cat"], synonyms=syn)
+        assert m == 1 and wm_h == pytest.approx(1.0)
 
 
 # -------------------------------------------------------------- evaluator
