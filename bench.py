@@ -20,7 +20,10 @@ Env knobs: BENCH_CHUNK (steps per dispatch), BENCH_ITERS, BENCH_PALLAS,
 BENCH_CST=0 to skip the CST section, BENCH_ATTN=0 to skip the
 attention-fusion XE bench (it compiles a second model), BENCH_DECODE=0
 to skip greedy/beam decode throughput, BENCH_LOADER=0 to skip the
-packed-loader assembly bench, BENCH_RNG to override the PRNG impl.
+packed-loader assembly bench, BENCH_RNG to override the PRNG impl,
+BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
+BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
+BENCH_MATCHED=0 to skip the chunk-10 matched-baseline re-run.
 """
 
 from __future__ import annotations
@@ -53,6 +56,11 @@ def _msrvtt_cfg():
     if os.environ.get("BENCH_PALLAS", "1") == "1":
         cfg.model.use_pallas_lstm = True
         cfg.model.use_pallas_attention = True
+    # Attention-MLP width sweep knob (VERDICT r2 #5: tanh cost is linear
+    # in att_hidden_size; the reference's 512 is convention, not physics).
+    ah = os.environ.get("BENCH_ATT_HIDDEN", "")
+    if ah:
+        cfg.model.att_hidden_size = int(ah)
     return cfg
 
 
@@ -85,8 +93,11 @@ def _fake_batch(cfg, rng):
 
 def xe_step_flops(cfg) -> float:
     """Analytic FLOPs per XE train step (fwd*3 for fwd+bwd), counting the
-    three GEMM families that dominate (SURVEY.md §3 hot loop #1): feature
-    projections, the LSTM recurrence, and the vocab logit GEMM."""
+    GEMM families that dominate (SURVEY.md §3 hot loop #1): feature
+    projections, the LSTM recurrence, the vocab logit GEMM — and, for
+    attention fusion, the per-step Bahdanau attention work (query proj,
+    score MLP over the concatenated frame axis, context reduction),
+    which the round-2 bench left uncounted (ADVICE r2 #4)."""
     B, S, F, T = (
         cfg.data.batch_size,
         cfg.data.seq_per_img,
@@ -102,10 +113,22 @@ def xe_step_flops(cfg) -> float:
     # LSTM: (input E + context E + hidden H) -> 4H gates, per token.
     lstm = 2.0 * rows * steps * (2 * E + H) * 4 * H
     logit = 2.0 * rows * steps * H * V
-    return 3.0 * (proj + lstm + logit)
+    attn = 0.0
+    if cfg.model.feature_fusion == "attention":
+        A = cfg.model.att_hidden_size
+        F_att = F * len(cfg.data.feature_modalities)  # concat frame axis
+        # One-time key projection (per VIDEO — like the feature
+        # projections, computed before the seq_per_img cache tiling) +
+        # per step per caption row: query proj (H -> A), score MLP
+        # (add+tanh+dot over A per frame), context reduction over E.
+        attn = (
+            2.0 * B * F_att * E * A
+            + 2.0 * rows * steps * (H * A + F_att * (A + E))
+        )
+    return 3.0 * (proj + lstm + logit + attn)
 
 
-def bench_xe(fusion: str = "meanpool"):
+def bench_xe(fusion: str = "meanpool", chunk: int = None):
     from cst_captioning_tpu.models import model_from_config
     from cst_captioning_tpu.parallel import (
         batch_sharding,
@@ -149,7 +172,7 @@ def bench_xe(fusion: str = "meanpool"):
     # chunk=10, ~0.2x of any improvement is this measurement fix — the
     # matched-chunk algorithmic speedup this round is ~1.18x (rbg PRNG,
     # docs/PERF.md).
-    chunk = bench_chunk()
+    chunk = chunk or bench_chunk()
     iters = int(os.environ.get("BENCH_ITERS", "6"))
 
     def run_chunk(state, rng, *op):
@@ -239,10 +262,6 @@ def bench_cst():
     batch = _fake_batch(cfg, np.random.RandomState(1))
     model = model_from_config(cfg)
     tx = make_optimizer(cfg.train, steps_per_epoch=100)
-    state = create_train_state(
-        jax.random.PRNGKey(0), model, tx, batch, mesh=None
-    )
-    step = make_cst_train_step(model, cfg, corpus)
     rewarder = CiderDRewarder(corpus, df_mode="corpus")
 
     feats = {m: jnp.asarray(v) for m, v in batch["feats"].items()}
@@ -250,24 +269,29 @@ def bench_cst():
     vid = jnp.asarray(batch["video_idx"])
     iters = int(os.environ.get("BENCH_ITERS", "6"))
 
-    def one(state, key):
-        state, metrics = step(
-            state, feats, masks, None, None, None, vid, key, 0.0
+    def time_step(step_cfg):
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, batch, mesh=None
         )
-        return state, metrics
+        step = make_cst_train_step(model, step_cfg, corpus)
+        state, metrics = step(  # warmup/compile
+            state, feats, masks, None, None, None, vid,
+            jax.random.PRNGKey(9), 0.0,
+        )
+        float(metrics["reward"])
+        rng = jax.random.PRNGKey(10)
+        times = []
+        for _ in range(iters):
+            rng, k = jax.random.split(rng)
+            t0 = time.perf_counter()
+            state, metrics = step(
+                state, feats, masks, None, None, None, vid, k, 0.0
+            )
+            float(metrics["loss"])
+            times.append(time.perf_counter() - t0)
+        return sorted(times)[len(times) // 2]
 
-    state, metrics = one(state, jax.random.PRNGKey(9))  # warmup/compile
-    float(metrics["reward"])
-
-    rng = jax.random.PRNGKey(10)
-    times = []
-    for _ in range(iters):
-        rng, k = jax.random.split(rng)
-        t0 = time.perf_counter()
-        state, metrics = one(state, k)
-        float(metrics["loss"])
-        times.append(time.perf_counter() - t0)
-    dt = sorted(times)[len(times) // 2]
+    dt = time_step(cfg)
     n_chips = max(1, len(jax.devices()))
 
     # Host scorer cost in isolation, on the same (B*S, T) id workload the
@@ -284,15 +308,36 @@ def bench_cst():
         rewarder.score_ids(vid_r, ids)
     scorer_ms = (time.perf_counter() - t0) / reps * 1e3
 
-    return {
+    out = {
         "cst_steps_per_sec_chip": round(1.0 / dt / n_chips, 4),
         "cst_variant": (
             "one_graph" if io_callback_supported() else "split"
         ),
+        "cst_score_chunks": cfg.train.cst_score_chunks,
         "cst_scorer_ms_per_step": round(scorer_ms, 2),
         "cst_scorer_backend": rewarder.backend,
         "cst_rollouts_per_step": B * S,
     }
+    # Scorer-overlap evidence (VERDICT r2 #2): the split step's chunked
+    # dispatch hides host scoring behind device compute; the unchunked
+    # (K=1) variant serializes them — the delta IS the recovered stall.
+    if (
+        out["cst_variant"] == "split"
+        and cfg.train.cst_score_chunks > 1
+        and os.environ.get("BENCH_CST_OVERLAP", "1") == "1"
+    ):
+        try:
+            cfg1 = cfg.replace(**{"train.cst_score_chunks": 1})
+            dt1 = time_step(cfg1)
+            out["cst_steps_per_sec_chip_nochunk"] = round(
+                1.0 / dt1 / n_chips, 4
+            )
+            out["cst_scorer_overlap_ms_recovered"] = round(
+                (dt1 - dt) * 1e3, 2
+            )
+        except Exception as e:
+            out["cst_overlap_error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def bench_decode():
@@ -342,8 +387,14 @@ def bench_decode():
             t0 = time.perf_counter()
             float(r(params))
             ts.append(time.perf_counter() - t0)
-        dt = sorted(ts)[len(ts) // 2] / 5
+        ts.sort()
+        dt = ts[len(ts) // 2] / 5
         out[label] = round(B / dt, 1)
+        # Tunnel/transport noise indicator (VERDICT r2 weak #5: decode
+        # numbers drifted between docs with no variance statement).
+        out[f"{label}_spread_pct"] = round(
+            100.0 * (ts[-1] - ts[0]) / ts[len(ts) // 2], 1
+        )
 
     timed(
         lambda p, f: greedy(p, f, masks, None), "greedy_videos_per_sec"
@@ -471,6 +522,18 @@ def main() -> int:
 
     prev = load_round_baseline(metric, unit)
     vs = sps_chip / prev if prev else 1.0
+    # The round-1 baseline was recorded at BENCH_CHUNK=10, where ~140ms
+    # of per-dispatch tunnel overhead deflates the number; vs_baseline
+    # therefore conflates the chunk-10->60 measurement fix with real
+    # speedup (VERDICT r2 weak #6).  Re-measure at chunk 10 so the
+    # apples-to-apples ratio is machine-readable.
+    if os.environ.get("BENCH_MATCHED", "1") == "1" and prev:
+        try:
+            sps10, _ = bench_xe(chunk=10)
+            extra["xe_steps_per_sec_chip_chunk10"] = round(sps10, 4)
+            extra["vs_baseline_matched_chunk"] = round(sps10 / prev, 4)
+        except Exception as e:
+            extra["matched_chunk_error"] = f"{type(e).__name__}: {e}"
     print(
         json.dumps(
             {

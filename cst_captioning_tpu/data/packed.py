@@ -21,6 +21,14 @@ gather automatically when every modality is packed
 (``H5Dataset.features_batch``).  ``tools/pack_features.py`` converts
 per-video h5s; :func:`pack_dataset` packs any ``CaptionDataset`` (used by
 tests/benchmarks).
+
+**Remote stores** (SURVEY.md §2 L1 plan: stream from object storage):
+any fsspec URL works as the packed directory — ``gs://bucket/dir``,
+``s3://…``, ``memory://…`` — detected by the ``://`` in the path.  The
+meta json is read through fsspec and row gathers become ranged reads
+against the remote ``.npy`` (header parsed once; each row is one
+``seek+read`` through fsspec's block cache), so no full-file download is
+needed.  Local paths keep the mmap fast path unchanged.
 """
 
 from __future__ import annotations
@@ -111,19 +119,98 @@ def pack_dataset(
     return paths
 
 
+def _is_remote(path: str) -> bool:
+    return "://" in path
+
+
+class _RemoteNpyRows:
+    """Row-gather view of a remote ``.npy`` through fsspec: the header is
+    parsed once, then ``[i]`` / ``[array_of_i]`` become ranged reads
+    (seek + read of one row's bytes) against the remote object — no full
+    download.  Supports exactly the access patterns ``PackedSource``
+    uses."""
+
+    def __init__(self, fs, path: str):
+        self._fs = fs
+        self._path = path
+        with fs.open(path, "rb") as f:
+            version = np.lib.format.read_magic(f)
+            if version == (1, 0):
+                shape, fortran, dtype = np.lib.format.read_array_header_1_0(f)
+            else:
+                shape, fortran, dtype = np.lib.format.read_array_header_2_0(f)
+            if fortran:
+                raise ValueError(f"{path}: fortran-order npy unsupported")
+            self._offset = f.tell()
+        self.shape = shape
+        self.dtype = dtype
+        self._row_bytes = int(np.prod(shape[1:])) * dtype.itemsize
+        # No block cache: training gathers are SHUFFLED row reads, so a
+        # readahead cache would fetch a multi-MB block per ~100KB row.
+        # Single rows use exact ranged reads; batches use one
+        # fs.cat_ranges call (concurrent on async filesystems).
+        self._f = fs.open(path, "rb", cache_type="none")
+        self._has_cat_ranges = hasattr(fs, "cat_ranges")
+
+    def _span(self, i: int):
+        start = self._offset + int(i) * self._row_bytes
+        return start, start + self._row_bytes
+
+    def _read_row(self, i: int) -> np.ndarray:
+        start, end = self._span(i)
+        self._f.seek(start)
+        buf = self._f.read(self._row_bytes)
+        return np.frombuffer(buf, dtype=self.dtype).reshape(self.shape[1:])
+
+    def __getitem__(self, key):
+        if isinstance(key, (int, np.integer)):
+            return self._read_row(key)
+        idxs = np.asarray(key)
+        if not self._has_cat_ranges:
+            return np.stack([self._read_row(i) for i in idxs])
+        spans = [self._span(i) for i in idxs]
+        # on_error="raise": the fsspec default ("return") hands back
+        # exception OBJECTS inside the list, which frombuffer would then
+        # bury under a TypeError.
+        bufs = self._fs.cat_ranges(
+            [self._path] * len(spans),
+            [s for s, _ in spans],
+            [e for _, e in spans],
+            on_error="raise",
+        )
+        return np.stack([
+            np.frombuffer(b, dtype=self.dtype).reshape(self.shape[1:])
+            for b in bufs
+        ])
+
+
 class PackedSource:
-    """Reader for one packed modality (memmap-backed, shared across
-    iterators; reads hit the OS page cache)."""
+    """Reader for one packed modality — memmap-backed for local paths
+    (reads hit the OS page cache), ranged fsspec reads for remote URLs
+    (``gs://…``, ``memory://…``)."""
 
     def __init__(self, directory: str, modality: str):
-        with open(_meta_path(directory, modality)) as f:
-            self.meta = json.load(f)
+        if _is_remote(directory):
+            import fsspec
+
+            fs, root = fsspec.core.url_to_fs(directory)
+            meta_path = root.rstrip("/") + f"/{modality}.meta.json"
+            with fs.open(meta_path) as f:
+                self.meta = json.load(f)
+            self._arr = _RemoteNpyRows(
+                fs, root.rstrip("/") + f"/{modality}.npy"
+            )
+        else:
+            with open(_meta_path(directory, modality)) as f:
+                self.meta = json.load(f)
+            self._arr = np.load(
+                _arr_path(directory, modality), mmap_mode="r"
+            )
         self.modality = modality
         self.frames = int(self.meta["frames"])
         self.dim = int(self.meta["dim"])
         self.frame_counts = np.asarray(self.meta["frame_counts"], np.int32)
         self.video_ids = list(self.meta["video_ids"])
-        self._arr = np.load(_arr_path(directory, modality), mmap_mode="r")
         assert self._arr.shape == (
             len(self.video_ids),
             self.frames,
@@ -134,7 +221,7 @@ class PackedSource:
         """(F_i, D) float32 — trimmed to the video's true frame count
         (CaptionDataset.features contract)."""
         n = int(self.frame_counts[idx])
-        return np.asarray(self._arr[idx, :n], np.float32)
+        return np.asarray(self._arr[idx][:n], np.float32)
 
     def get_batch(
         self, idxs: np.ndarray, max_frames: int
@@ -165,7 +252,19 @@ class PackedSource:
 
 def is_packed_dir(path: str) -> bool:
     """Heuristic used by ``H5Dataset``: a directory containing at least
-    one ``*.meta.json`` packed-modality pair."""
+    one ``*.meta.json`` packed-modality pair (local or fsspec URL)."""
+    if _is_remote(path):
+        import fsspec
+
+        fs, root = fsspec.core.url_to_fs(path)
+        try:
+            names = fs.ls(root, detail=False)
+        except FileNotFoundError:
+            # Only "no such directory" maps to False; auth/transport
+            # errors propagate — swallowing them would misroute the path
+            # to the h5 reader and bury the real cause.
+            return False
+        return any(str(n).endswith(".meta.json") for n in names)
     if not os.path.isdir(path):
         return False
     return any(n.endswith(".meta.json") for n in os.listdir(path))

@@ -73,6 +73,24 @@ class DecodeCache(NamedTuple):
     cat_emb: jax.Array     # (B, C) category embedding ((B, 0) when unused)
 
 
+def _repeat_cache(cache: DecodeCache, repeat: int) -> DecodeCache:
+    """Tile each per-video cache row ``repeat`` times (row i -> rows
+    i*repeat..(i+1)*repeat-1, matching ``jnp.repeat`` on the raw batch).
+
+    This is THE seq_per_img / rollout fan-out: projecting B videos' raw
+    features and repeating the (much smaller) projected cache does ~S x
+    less GEMM work than repeating the raw (B, F, 2048/4096) features
+    before the projections — at MSR-VTT shape (S=20) the projections are
+    ~25% of step FLOPs when done after the repeat and ~1% when done
+    before it, with bit-identical forward results (each row's GEMM is
+    row-independent)."""
+    if repeat <= 1:
+        return cache
+    return DecodeCache(
+        *(jnp.repeat(x, repeat, axis=0) for x in cache)
+    )
+
+
 def _uniform_init(scale: float):
     def init(key, shape, dtype):
         return jax.random.uniform(key, shape, dtype, -scale, scale)
@@ -324,6 +342,7 @@ class CaptionModel(nn.Module):
         ss_prob: jax.Array | float = 0.0,
         deterministic: bool = True,
         rng: Optional[jax.Array] = None,
+        repeat: int = 1,
     ) -> jax.Array:
         """Teacher-forced forward.  ``input_ids`` (B, T) starts with BOS;
         returns logits (B, T, V) predicting ``input_ids`` shifted left.
@@ -331,9 +350,16 @@ class CaptionModel(nn.Module):
         ``ss_prob`` enables scheduled sampling (reference ``opts.py``
         scheduled_sampling_*): with that probability per token, the input is
         the model's own sample from the previous step instead of the GT.
+
+        ``repeat``: caption rows per video — ``feats`` holds B videos and
+        ``input_ids`` B*repeat caption rows (row-major per video); the
+        projected cache is tiled AFTER the feature projections
+        (:func:`_repeat_cache`), not the raw features before them.
         """
         B, T = input_ids.shape
-        cache = self._encode(feats, feat_masks, category)
+        cache = _repeat_cache(
+            self._encode(feats, feat_masks, category), repeat
+        )
         state0 = self._init_state(B)
         if rng is None:
             rng = jax.random.PRNGKey(0)
@@ -463,6 +489,7 @@ class CaptionModel(nn.Module):
         max_len: int = 30,
         greedy: bool = True,
         temperature: float = 1.0,
+        repeat: int = 1,
     ) -> SampleOutput:
         """Autoregressive decode under ``jit``: exactly ``max_len`` steps,
         finished sequences emit PAD with zero log-prob (fixed shapes — no
@@ -470,8 +497,15 @@ class CaptionModel(nn.Module):
         ``greedy=False`` is the multinomial rollout (temperature-scaled),
         with log-probs taken from the same scaled distribution the token was
         drawn from, as REINFORCE requires.
+
+        ``repeat``: rollouts per video (CST_MS) — the projected cache is
+        tiled after the feature projections, so S rollouts cost S x the
+        decode but 1 x the encode (:func:`_repeat_cache`).
         """
         state, cache = self.init_decode(feats, feat_masks, category)
+        if repeat > 1:
+            cache = _repeat_cache(cache, repeat)
+            state = self._init_state(cache.ctx_static.shape[0])
         B = state.h.shape[1]
         if rng is None:
             rng = jax.random.PRNGKey(0)

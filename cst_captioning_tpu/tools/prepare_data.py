@@ -100,7 +100,11 @@ def consensus_weights(
     with per-video-df weights collapsed val CIDEr to 0 by amplifying
     the generic caption it is meant to suppress.
     """
-    cooked = [precook(t) for t in tokenized]
+    # ``tokenized`` may be pre-cooked n-gram counters (from a caller that
+    # already cooked the split for its df table) or raw token lists.
+    cooked = [
+        t if isinstance(t, dict) else precook(t) for t in tokenized
+    ]
     n = len(cooked)
     if n < 2:
         return np.ones((n,), np.float32)
@@ -235,13 +239,17 @@ def prepare(
         # frequencies (one document per video's reference set) — the
         # standard-CIDEr df the paper's consensus score implies.  For
         # the train split this is the same corpus as the idf table.
-        split_df = compute_doc_freq(
-            [[precook(t) for t in tokenized[vid]] for vid in vids]
-        )
+        # Cook each split once; consensus_weights accepts the cooked
+        # counters directly.
+        split_cooked = {
+            vid: [precook(t) for t in tokenized[vid]] for vid in vids
+        }
+        split_df = compute_doc_freq(list(split_cooked.values()))
         split_log_ref = math.log(max(float(len(vids)), 2.0))
         weights = {
             vid: consensus_weights(
-                tokenized[vid], df=split_df, log_ref_len=split_log_ref
+                split_cooked[vid], df=split_df,
+                log_ref_len=split_log_ref,
             )
             for vid in vids
         }

@@ -81,19 +81,13 @@ def _validate(cfg):
     return S, baseline_kind
 
 
-def _repeat_batch(feats, feat_masks, category, video_idx, S):
-    feats_r = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
-    masks_r = {m: jnp.repeat(v, S, axis=0) for m, v in feat_masks.items()}
-    cat_r = jnp.repeat(category, S, axis=0) if category is not None else None
-    vid_r = jnp.repeat(video_idx, S, axis=0)
-    return feats_r, masks_r, cat_r, vid_r
-
-
-def _pg_update(state, feats_r, masks_r, cat_r, tokens, mask, advantage,
-               temperature):
+def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
+               advantage, temperature):
     """PG loss + Adam update: re-run teacher forcing over the SAMPLED
     tokens so the graph from logits to params is differentiable (the
-    rollout is decode-only).  Input = [BOS, tok_0..tok_{L-2}]."""
+    rollout is decode-only).  Input = [BOS, tok_0..tok_{L-2}].  ``feats``
+    holds the B un-tiled videos; ``repeat=S`` tiles the projected cache
+    to the B*S sampled rows (see ``_repeat_cache``)."""
     B = tokens.shape[0]
     bos = jnp.full((B, 1), BOS_ID, jnp.int32)
     inputs = jnp.concatenate([bos, tokens[:, :-1]], axis=1)
@@ -102,7 +96,7 @@ def _pg_update(state, feats_r, masks_r, cat_r, tokens, mask, advantage,
 
     def loss_fn(params):
         logits = state.apply_fn(
-            params, feats_r, masks_r, inputs, category=cat_r
+            params, feats, feat_masks, inputs, category=category, repeat=S
         )
         # REINFORCE needs log-probs of the distribution that was actually
         # sampled from: same PAD/BOS masking AND the same temperature
@@ -219,13 +213,11 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
         B = video_idx.shape[0]
-        feats_r, masks_r, cat_r, vid_r = _repeat_batch(
-            feats, feat_masks, category, video_idx, S
-        )
+        vid_r = jnp.repeat(video_idx, S, axis=0)
         rollout = state.apply_fn(
-            state.params, feats_r, masks_r, rng=rng, category=cat_r,
+            state.params, feats, feat_masks, rng=rng, category=category,
             max_len=max_len, greedy=False, temperature=temperature,
-            method="sample",
+            method="sample", repeat=S,
         )
         rewards = score(vid_r, rollout.tokens)  # (B*S,)
 
@@ -244,8 +236,8 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
         advantage = rewards - baseline
 
         state, loss, gnorm = _pg_update(
-            state, feats_r, masks_r, cat_r, rollout.tokens, rollout.mask,
-            advantage, temperature,
+            state, feats, feat_masks, category, S, rollout.tokens,
+            rollout.mask, advantage, temperature,
         )
         return state, {
             "loss": loss,
@@ -291,13 +283,10 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
 
     @jax.jit
     def rollout_chunk(params, feats, feat_masks, category, rng):
-        feats_r, masks_r, cat_r, _ = _repeat_batch(
-            feats, feat_masks, category, jnp.zeros(1, jnp.int32), S
-        )
         rollout = model.apply(
-            params, feats_r, masks_r, rng=rng, category=cat_r,
+            params, feats, feat_masks, rng=rng, category=category,
             max_len=max_len, greedy=False, temperature=temperature,
-            method="sample",
+            method="sample", repeat=S,
         )
         return rollout.tokens, rollout.mask
 
@@ -311,16 +300,13 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
     @functools.partial(jax.jit, donate_argnums=(0,))
     def update_fn(state, feats, feat_masks, category, tokens_chunks,
                   mask_chunks, advantage):
-        # Chunks concatenate back to the exact _repeat_batch row order
+        # Chunks concatenate back to the exact repeated row order
         # (chunk c holds rows [lo*S, hi*S) of the repeated batch).
         tokens = jnp.concatenate(tokens_chunks, axis=0)
         mask = jnp.concatenate(mask_chunks, axis=0)
-        feats_r, masks_r, cat_r, _ = _repeat_batch(
-            feats, feat_masks, category, jnp.zeros(1, jnp.int32), S
-        )
         state, loss, gnorm = _pg_update(
-            state, feats_r, masks_r, cat_r, tokens, mask, advantage,
-            temperature,
+            state, feats, feat_masks, category, S, tokens, mask,
+            advantage, temperature,
         )
         return state, loss, gnorm
 

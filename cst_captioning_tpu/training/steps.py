@@ -126,18 +126,17 @@ def create_train_state(
     return state.replace(opt_state=jax.tree.map(place, state.opt_state))
 
 
-def _flatten_batch(model: CaptionModel, feats, feat_masks, captions, weights,
-                   category):
-    """(B, S, L) captions + (B, ...) video tensors -> caption-major arrays:
-    every caption row gets its video's features (jnp.repeat on device —
-    the reference tiles on host in ``dataloader.py``)."""
+def _flatten_batch(captions, weights):
+    """(B, S, L) captions -> caption-major (B*S, L) + flat weights.
+
+    Features/category are NOT tiled here: the model's ``repeat=S`` tiles
+    the projected cache after the feature projections (the reference
+    tiles raw features on host in ``dataloader.py`` — S x the projection
+    GEMMs for identical results; see ``_repeat_cache``)."""
     B, S, L = captions.shape
-    feats_r = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
-    masks_r = {m: jnp.repeat(v, S, axis=0) for m, v in feat_masks.items()}
     caps = captions.reshape(B * S, L)
     w = weights.reshape(B * S)
-    cat = jnp.repeat(category, S, axis=0) if category is not None else None
-    return feats_r, masks_r, caps, w, cat
+    return caps, w, S
 
 
 def make_xe_train_step(
@@ -155,9 +154,7 @@ def make_xe_train_step(
 
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
-        feats_r, masks_r, caps, w, cat = _flatten_batch(
-            model, feats, feat_masks, captions, weights, category
-        )
+        caps, w, S = _flatten_batch(captions, weights)
         inputs, targets = caps[:, :-1], caps[:, 1:]
         tmask = (targets != PAD_ID).astype(jnp.float32)
         rng_drop, rng_ss = jax.random.split(rng)
@@ -165,14 +162,15 @@ def make_xe_train_step(
         def loss_fn(params):
             logits = state.apply_fn(
                 params,
-                feats_r,
-                masks_r,
+                feats,
+                feat_masks,
                 inputs,
-                category=cat,
+                category=category,
                 ss_prob=ss_prob,
                 deterministic=False,
                 rng=rng_ss,
                 rngs={"dropout": rng_drop},
+                repeat=S,
             )
             return weighted_cross_entropy(logits, targets, tmask, w)
 

@@ -61,6 +61,51 @@ class TestPackRoundTrip:
                 PackedSource(d, "resnet").get_batch(np.asarray([0]), bad)
 
 
+class TestRemotePackedSource:
+    """fsspec-URL packed stores (VERDICT r2 #8): the memory:// filesystem
+    stands in for gs:// — same code path (url_to_fs + ranged reads)."""
+
+    @pytest.fixture()
+    def remote_dir(self, corpus, tmp_path):
+        fsspec = pytest.importorskip("fsspec")
+        ds, _ = corpus
+        local = str(tmp_path / "packed_remote_src")
+        pack_dataset(ds, local, max_frames=6, dtype="float16")
+        fs = fsspec.filesystem("memory")
+        import os
+
+        for name in os.listdir(local):
+            with open(os.path.join(local, name), "rb") as f:
+                fs.pipe(f"/packtest/{name}", f.read())
+        yield "memory://packtest"
+        fs.rm("/packtest", recursive=True)
+
+    def test_is_packed_dir_remote(self, remote_dir):
+        assert is_packed_dir(remote_dir)
+        assert not is_packed_dir("memory://no_such_dir_anywhere")
+
+    def test_remote_matches_local(self, corpus, tmp_path, remote_dir):
+        ds, _ = corpus
+        local = str(tmp_path / "packed_remote_src")
+        src_l = PackedSource(local, "resnet")
+        src_r = PackedSource(remote_dir, "resnet")
+        assert src_r.video_ids == src_l.video_ids
+        for i in (0, 7, 19):
+            np.testing.assert_array_equal(src_r.get(i), src_l.get(i))
+        idxs = np.asarray([5, 0, 19, 5])
+        fr, mr = src_r.get_batch(idxs, 6)
+        fl, ml = src_l.get_batch(idxs, 6)
+        assert fr.dtype == fl.dtype == np.float16  # stored dtype kept
+        np.testing.assert_array_equal(np.asarray(fr), np.asarray(fl))
+        np.testing.assert_array_equal(mr, ml)
+
+    def test_remote_max_frames_guard(self, remote_dir):
+        with pytest.raises(ValueError, match="packed frames"):
+            PackedSource(remote_dir, "resnet").get_batch(
+                np.asarray([0]), 5
+            )
+
+
 class TestLoaderFastPath:
     def test_batches_identical_to_per_video(self, corpus, tmp_path):
         """The packed gather must produce bit-identical batches to the
