@@ -172,12 +172,17 @@ def fabricate(
                     np.float32
                 )
                 if scene_mix > 0.0:
+                    # All scene-mix randomness (frame choice AND the
+                    # distractor frames' noise) comes from the per-video
+                    # scene rng: the main stream is untouched, so mixing
+                    # perturbs ONLY place slices vs the unmixed corpus.
                     q_i, frac = scene_plan[i]
                     k = int(round(frac * nf))
-                    which = _scene_rng(seed, i).permutation(nf)[:k]
+                    srng = _scene_rng(seed, i)
+                    which = srng.permutation(nf)[:k]
                     frames[which, dn + dv:] = (
                         place_emb[q_i][None, :]
-                        + noise * rng.randn(k, dp).astype(np.float32)
+                        + noise * srng.randn(k, dp).astype(np.float32)
                     )
                 f.create_dataset(f"video{i}", data=frames.astype(np.float32))
         feats[m] = path

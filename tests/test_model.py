@@ -121,6 +121,51 @@ class TestForward:
         for path, g in flat:
             assert np.abs(np.asarray(g)).sum() > 0, f"zero grad at {path}"
 
+    @pytest.mark.parametrize("fusion", ["meanpool", "attention"])
+    def test_repeat_matches_pretiled_features(self, np_rng, fusion):
+        """repeat=S (cache tiled AFTER the projections) must equal
+        tiling the raw features BEFORE the model — the S x projection
+        saving may not change a single logit."""
+        S = 3
+        model = make_model(fusion=fusion)
+        feats, masks, ids = make_batch(np_rng)
+        ids_r = jnp.repeat(ids, S, axis=0)
+        params = model.init(jax.random.PRNGKey(0), feats, masks, ids)
+        out_repeat = model.apply(params, feats, masks, ids_r, repeat=S)
+        feats_t = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
+        masks_t = {m: jnp.repeat(v, S, axis=0) for m, v in masks.items()}
+        out_tiled = model.apply(params, feats_t, masks_t, ids_r)
+        np.testing.assert_allclose(
+            np.asarray(out_repeat), np.asarray(out_tiled),
+            rtol=1e-6, atol=1e-6,
+        )
+
+    def test_repeat_grads_match_pretiled(self, np_rng):
+        S = 2
+        model = make_model()
+        feats, masks, ids = make_batch(np_rng)
+        ids_r = jnp.repeat(ids, S, axis=0)
+        params = model.init(jax.random.PRNGKey(0), feats, masks, ids)
+        feats_t = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
+        masks_t = {m: jnp.repeat(v, S, axis=0) for m, v in masks.items()}
+
+        def loss_repeat(p):
+            return jnp.sum(
+                model.apply(p, feats, masks, ids_r, repeat=S) ** 2
+            )
+
+        def loss_tiled(p):
+            return jnp.sum(model.apply(p, feats_t, masks_t, ids_r) ** 2)
+
+        g1 = jax.grad(loss_repeat)(params)
+        g2 = jax.grad(loss_tiled)(params)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-4
+            ),
+            g1, g2,
+        )
+
     def test_scheduled_sampling_changes_output(self, np_rng):
         model = make_model()
         feats, masks, ids = make_batch(np_rng)
@@ -227,6 +272,31 @@ class TestSample:
 
         out = run(params, feats, masks, jax.random.PRNGKey(0))
         assert out.tokens.shape == (B, T)
+
+    def test_sample_repeat_matches_pretiled(self, np_rng):
+        """Greedy decode with repeat=S == greedy decode on pre-tiled
+        features (deterministic, so exact token equality)."""
+        S = 3
+        model = make_model()
+        feats, masks, ids = make_batch(np_rng)
+        params = model.init(jax.random.PRNGKey(0), feats, masks, ids)
+        out_r = model.apply(
+            params, feats, masks, greedy=True, max_len=T,
+            method="sample", repeat=S,
+        )
+        feats_t = {m: jnp.repeat(v, S, axis=0) for m, v in feats.items()}
+        masks_t = {m: jnp.repeat(v, S, axis=0) for m, v in masks.items()}
+        out_t = model.apply(
+            params, feats_t, masks_t, greedy=True, max_len=T,
+            method="sample",
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_r.tokens), np.asarray(out_t.tokens)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_r.logprobs), np.asarray(out_t.logprobs),
+            rtol=1e-5, atol=1e-6,
+        )
 
     def test_decode_one_matches_sample_first_step(self, np_rng):
         model, params, feats, masks = self._setup(np_rng)

@@ -1,11 +1,14 @@
 """End-to-end rehearsal tool: the full fabricate -> prep -> pack ->
-XE/WXE/CST pipeline -> beam eval chain at tiny scale."""
+XE/WXE/CST pipeline -> beam eval chain at tiny scale, plus the corpus
+generator's structural guarantees (generic trap, scene mix, sweep-mode
+manifest)."""
 
 import json
 
 import numpy as np
+import pytest
 
-from cst_captioning_tpu.tools.rehearsal import main
+from cst_captioning_tpu.tools.rehearsal import _GENERIC, fabricate, main
 
 
 def test_rehearsal_end_to_end(tmp_path, capsys):
@@ -35,3 +38,75 @@ def test_rehearsal_end_to_end(tmp_path, capsys):
     assert (tmp_path / "r" / "packed" / "resnet.npy").exists()
     assert (tmp_path / "r" / "prep" / "consensus_train.json").exists()
     assert (tmp_path / "r" / "checkpoints" / "rehearsal_cst").exists()
+    # sweep-mode manifest written last (certifies prep+pack completed)
+    assert (tmp_path / "r" / "prep" / "manifest.json").exists()
+
+
+class TestFabricate:
+    def test_generic_block_and_consensus_structure(self, tmp_path):
+        """The corpus-v2 invariants: generic refs are corpus-wide
+        identical (idf ~ 0 by construction) and every video carries
+        specific refs naming its topic."""
+        raw = fabricate(str(tmp_path / "c"), 12, {"resnet": 24}, seed=3,
+                        generic_refs=8)
+        ann = json.load(open(raw["annotations"]))
+        per_vid = {}
+        for s in ann["sentences"]:
+            per_vid.setdefault(s["video_id"], []).append(s["caption"])
+        generic = " ".join(_GENERIC)
+        for vid, caps in per_vid.items():
+            assert caps.count(generic) == 8
+            assert len(caps) == 20
+            specific = [c for c in caps if c != generic]
+            # modal caption is the generic one
+            assert max(specific.count(c) for c in specific) < 8
+
+    def test_scene_mix_perturbs_only_place_slice(self, tmp_path):
+        """The scene-mix no-op-stream invariant: turning mixing ON must
+        leave noun/verb feature slices AND the annotations bit-identical
+        to the unmixed corpus (all mix randomness on a separate rng),
+        while actually re-scening some place slices."""
+        import h5py
+
+        a = fabricate(str(tmp_path / "a2"), 6, {"resnet": 24}, seed=1)
+        c = fabricate(str(tmp_path / "c2"), 6, {"resnet": 24}, seed=1,
+                      scene_mix=0.5)
+        assert (
+            json.load(open(a["annotations"]))
+            == json.load(open(c["annotations"]))
+        )
+        d = 24
+        dn = dv = d // 3
+        changed = 0
+        with h5py.File(a["resnet"]) as fa, h5py.File(c["resnet"]) as fc:
+            for k in fa:
+                va, vc = fa[k][()], fc[k][()]
+                # noun+verb slices untouched
+                np.testing.assert_array_equal(
+                    va[:, : dn + dv], vc[:, : dn + dv]
+                )
+                changed += int(
+                    not np.array_equal(va[:, dn + dv:], vc[:, dn + dv:])
+                )
+        assert changed > 0  # some videos actually got a second scene
+
+
+class TestSweepManifest:
+    def _args(self, out):
+        return [
+            "--out-dir", out, "--videos", "16", "--epochs", "1",
+            "--batch-size", "8", "--max-frames", "4", "--max-words", "6",
+            "--beam-size", "2", "--cst-samples", "2",
+            "--feature-dims", "resnet=8,c3d=8", "--stages", "xe",
+        ]
+
+    def test_reuse_rejects_mismatched_corpus(self, tmp_path, capsys):
+        out = str(tmp_path / "m")
+        assert main(self._args(out)) == 0
+        capsys.readouterr()
+        with pytest.raises(ValueError, match="fresh --out-dir"):
+            main(self._args(out) + ["--reuse-data", "--generic-refs", "2"])
+
+    def test_reuse_without_manifest_rejected(self, tmp_path):
+        with pytest.raises(FileNotFoundError, match="manifest"):
+            main(self._args(str(tmp_path / "nope")) + ["--reuse-data"])
