@@ -297,6 +297,10 @@ def run(args) -> Dict:
     cfg.eval.metrics = ["Bleu_4", "METEOR", "ROUGE_L", "CIDEr"]
     if args.use_pallas:
         cfg.model.use_pallas_lstm = True
+    if args.fusion:
+        cfg.model.feature_fusion = args.fusion
+    if args.att_hidden:
+        cfg.model.att_hidden_size = args.att_hidden
 
     stages = [s.strip() for s in args.stages.split(",") if s.strip()]
     # CST sweep knobs (VERDICT r2 #1): override the cst/cst_greedy stage
@@ -320,6 +324,13 @@ def run(args) -> Dict:
         "feature_dims": dims,
         "run_name": args.run_name,
         "cst_overrides": cst_over,
+        "model_overrides": {
+            k: v for k, v in (
+                ("feature_fusion", args.fusion),
+                ("att_hidden_size", args.att_hidden),
+            ) if v
+        },
+        "scene_mix": args.scene_mix,
         "stages": {},
         "test_scores": results.get("eval", {}).get("scores", {}),
     }
@@ -352,6 +363,11 @@ def main(argv=None) -> int:
     p.add_argument("--cst-samples", type=int, default=5)
     p.add_argument("--feature-dims", default="resnet=2048,c3d=4096")
     p.add_argument("--use-pallas", action="store_true")
+    p.add_argument("--fusion", default=None,
+                   choices=["meanpool", "attention"],
+                   help="override model.feature_fusion")
+    p.add_argument("--att-hidden", type=int, default=None,
+                   help="override model.att_hidden_size (A-width sweeps)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--generic-refs", type=int, default=8,
                    help="per-video copies of the corpus-wide generic "
