@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time
 from typing import Callable
 
 import jax
@@ -49,6 +50,24 @@ from cst_captioning_tpu.ops.losses import reward_criterion
 from cst_captioning_tpu.training.rewards import CiderDRewarder
 
 log = logging.getLogger("cst_captioning_tpu.cst")
+
+
+@functools.lru_cache(maxsize=None)
+def dispatch_latency_ms() -> float:
+    """Median round-trip of a trivial jitted dispatch on the default
+    backend.  On a local TPU-VM host this is ~O(0.1 ms); through a
+    tunneled/remote runtime it can be >100 ms — large enough that any
+    scheme spending extra dispatches to overlap host work (the chunked
+    split CST step) costs more than it recovers."""
+    f = jax.jit(lambda x: x + 1.0)
+    x = jnp.float32(0.0)
+    float(f(x))  # compile
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(f(x))
+        times.append(time.perf_counter() - t0)
+    return sorted(times)[len(times) // 2] * 1e3
 
 
 @functools.lru_cache(maxsize=None)
@@ -255,6 +274,11 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
 
 # ----------------------------------------------------------- split variant
 
+# Above this per-dispatch latency, chunked scoring overlap can't pay for
+# its extra dispatches (see _make_split_step docstring).
+_CHUNK_MAX_DISPATCH_MS = 5.0
+
+
 def _chunk_count(requested: int, B: int) -> int:
     """Largest divisor of ``B`` that is <= ``requested`` (>= 1)."""
     k = max(1, min(requested, B))
@@ -274,12 +298,33 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
     during scoring drops from the full scoring cost to ~1/K of it; the
     math is identical for any K (every chunk samples from the same
     params — only the rng stream differs from the unchunked dispatch,
-    which K=1 reproduces bit-for-bit)."""
+    which K=1 reproduces bit-for-bit).
+
+    Chunking pays ~2K-1 EXTRA dispatches per step, so it only wins when
+    per-dispatch latency is far below the scorer cost.  On a tunneled
+    runtime (measured ~140 ms RTT here, vs a ~44 ms scorer) it LOSES
+    2-3x; the step therefore probes :func:`dispatch_latency_ms` once and
+    falls back to the fused single-dispatch layout (rollout + greedy
+    baseline in ONE graph) when dispatch latency exceeds
+    ``_CHUNK_MAX_DISPATCH_MS``."""
     S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
     need_greedy = baseline_kind == "greedy"
     k_requested = max(1, getattr(cfg.train, "cst_score_chunks", 1))
+    # High-latency (tunneled) runtimes take the FUSED single-dispatch
+    # layout: every extra dispatch costs a full RTT, more than any
+    # host-scoring overlap recovers.  Low-latency hosts keep separate
+    # rollout/greedy dispatches even at K=1 — scoring the rollout while
+    # the device computes the greedy baseline is free overlap there.
+    latency_gated = dispatch_latency_ms() > _CHUNK_MAX_DISPATCH_MS
+    if latency_gated and k_requested > 1:
+        log.warning(
+            "cst_score_chunks=%d disabled: per-dispatch latency %.1f ms "
+            "exceeds %.0f ms — extra dispatches would cost more than the "
+            "host-scoring overlap recovers (tunneled runtime)",
+            k_requested, dispatch_latency_ms(), _CHUNK_MAX_DISPATCH_MS,
+        )
 
     @jax.jit
     def rollout_chunk(params, feats, feat_masks, category, rng):
@@ -289,6 +334,24 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             method="sample", repeat=S,
         )
         return rollout.tokens, rollout.mask
+
+    @jax.jit
+    def rollout_fused(params, feats, feat_masks, category, rng):
+        """K=1 layout: rollout AND greedy baseline in one dispatch (two
+        device->host crossings per step total, the reference's own
+        structure, SURVEY.md §3.2)."""
+        tokens, mask = rollout_chunk.__wrapped__(
+            params, feats, feat_masks, category, rng
+        )
+        greedy_tokens = (
+            model.apply(
+                params, feats, feat_masks, category=category,
+                max_len=max_len, greedy=True, method="sample",
+            ).tokens
+            if need_greedy
+            else jnp.zeros((1, max_len), jnp.int32)
+        )
+        return tokens, mask, greedy_tokens
 
     @jax.jit
     def greedy_chunk(params, feats, feat_masks, category):
@@ -327,7 +390,11 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
         sharded = any(map(_multi_device, feats.values())) or _multi_device(
             video_idx
         )
-        K = 1 if sharded else _chunk_count(k_requested, B)
+        K = (
+            1
+            if (sharded or latency_gated)
+            else _chunk_count(k_requested, B)
+        )
         step = B // K
         bounds = [(c * step, (c + 1) * step) for c in range(K)]
 
@@ -338,18 +405,32 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             return f, fm, cat
 
         # Phase 1 — enqueue EVERYTHING the scorer will consume before
-        # blocking: K rollout chunks, then the greedy baseline decode
-        # (its compute hides the tail rollout chunks' scoring).
-        dispatched = []
-        for c, (lo, hi) in enumerate(bounds):
-            crng = jax.random.fold_in(rng, c) if K > 1 else rng
-            f, fm, cat = bslice(lo, hi)
-            dispatched.append(rollout_chunk(state.params, f, fm, cat, crng))
-        greedy_parts = (
-            [greedy_chunk(state.params, *bslice(lo, hi)) for lo, hi in bounds]
-            if need_greedy
-            else []
-        )
+        # blocking.  Tunneled runtime: one fused dispatch (rollout +
+        # greedy).  Otherwise: K rollout chunks, then the greedy
+        # baseline decode (its compute hides the tail rollout chunks'
+        # scoring; at K=1 it still hides the rollout's scoring).
+        if latency_gated:
+            tokens, mask, greedy_tokens = rollout_fused(
+                state.params, feats, feat_masks, category, rng
+            )
+            dispatched = [(tokens, mask)]
+            greedy_parts = [greedy_tokens] if need_greedy else []
+        else:
+            dispatched = []
+            for c, (lo, hi) in enumerate(bounds):
+                crng = jax.random.fold_in(rng, c) if K > 1 else rng
+                f, fm, cat = bslice(lo, hi)
+                dispatched.append(
+                    rollout_chunk(state.params, f, fm, cat, crng)
+                )
+            greedy_parts = (
+                [
+                    greedy_chunk(state.params, *bslice(lo, hi))
+                    for lo, hi in bounds
+                ]
+                if need_greedy
+                else []
+            )
 
         # Phase 2 — host scoring, pipelined: np.asarray(chunk c) blocks
         # only on chunk c's dispatch; later chunks keep the device busy.
