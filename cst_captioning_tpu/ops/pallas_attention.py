@@ -205,8 +205,16 @@ def fused_context_attention(q, att_proj, att_mask, att_vals, att_v,
                             use_pallas: bool = True):
     """One decode step of Bahdanau context attention.
 
-    Kernel path when enabled and the batch tiles; dense XLA otherwise.
+    Kernel path when enabled and the shapes tile; dense XLA otherwise.
+    On a real TPU the minor (lane) dims — att_hidden A and embed E —
+    must fill the 128-lane registers: at A=64 Mosaic fails to lower the
+    kernel's (bt, F, A) reshapes ("infer-vector-layout: unsupported
+    shape cast"), so narrow widths take the dense path.  Interpret mode
+    (CPU tests) has no lane constraint.
     """
-    if use_pallas and _pick_bt(q.shape[0]) is not None:
+    A = att_proj.shape[-1]
+    E = att_vals.shape[-1]
+    lanes_ok = _interpret() or (A % 128 == 0 and E % 128 == 0)
+    if use_pallas and _pick_bt(q.shape[0]) is not None and lanes_ok:
         return _fused(q, att_proj, att_mask, att_vals, att_v)
     return dense_context_attention(q, att_proj, att_mask, att_vals, att_v)
