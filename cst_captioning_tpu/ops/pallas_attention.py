@@ -22,8 +22,10 @@ Numerics match ``CaptionModel._context``'s dense path: tanh/matmuls in
 the compute dtype, score/softmax in float32, masked positions at -1e30.
 Shapes: q (B, A); att_proj (B, F, A); att_mask (B, F); att_vals
 (B, F, E); att_v (A, 1) -> context (B, E).  Falls back to dense XLA when
-the batch can't tile (B < 8 or not a multiple of 8) or when not on a TPU
-backend (interpret mode covers CPU tests).
+the batch can't tile (B < 8 or not a multiple of 8), when A or E is not
+a multiple of the 128-lane register width (Mosaic fails to lower
+narrower minor dims), or when not on a TPU backend (interpret mode
+covers CPU tests).
 """
 
 from __future__ import annotations
@@ -207,10 +209,12 @@ def fused_context_attention(q, att_proj, att_mask, att_vals, att_v,
 
     Kernel path when enabled and the shapes tile; dense XLA otherwise.
     On a real TPU the minor (lane) dims — att_hidden A and embed E —
-    must fill the 128-lane registers: at A=64 Mosaic fails to lower the
-    kernel's (bt, F, A) reshapes ("infer-vector-layout: unsupported
-    shape cast"), so narrow widths take the dense path.  Interpret mode
-    (CPU tests) has no lane constraint.
+    must be MULTIPLES of the 128-lane register width (the conservative
+    proven-good set): at A=64 Mosaic fails to lower the kernel's
+    (bt, F, A) reshapes ("infer-vector-layout: unsupported shape
+    cast"), and non-multiples like 192 are routed to dense as untested
+    rather than risked.  Interpret mode (CPU tests) has no lane
+    constraint.
     """
     A = att_proj.shape[-1]
     E = att_vals.shape[-1]

@@ -102,60 +102,78 @@ def xe_pretrain(ds, tmp_path, epochs=60):
     return t
 
 
+def split_setup(corpus, tmp_path, baseline, **cfg_over):
+    """Shared harness for the split/one-graph step-equivalence tests:
+    config, model, one fixed batch, optimizer, rewarder and a runner
+    that builds a fresh state and applies one step."""
+    from cst_captioning_tpu.data import BatchIterator
+    from cst_captioning_tpu.models import model_from_config
+    from cst_captioning_tpu.training.rewards import CiderDRewarder
+    from cst_captioning_tpu.training.steps import (
+        create_train_state,
+        make_optimizer,
+    )
+
+    ds, _ = corpus
+    cfg = cst_cfg(tmp_path, baseline, **cfg_over)
+    cfg.model.vocab_size = len(ds.vocab)
+    model = model_from_config(cfg)
+    it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
+                       shuffle=False)
+    batch = next(iter(it.epoch(0)))
+    tx = make_optimizer(cfg.train, 10)
+    rewarder = CiderDRewarder(ds)
+    rng = jax.random.PRNGKey(3)
+
+    def run(step_fn):
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, batch._asdict()
+        )
+        return step_fn(
+            state, batch.feats, batch.feat_masks, batch.captions,
+            batch.weights, None, batch.video_idx, rng, 0.0,
+        )
+
+    return cfg, model, rewarder, run
+
+
+def assert_same_update(result_a, result_b):
+    """Two (state, metrics) step results must agree on the scalar
+    metrics and every updated parameter."""
+    s1, m1 = result_a
+    s2, m2 = result_b
+    for k in ("loss", "reward", "baseline"):
+        np.testing.assert_allclose(
+            float(m1[k]), float(m2[k]), rtol=1e-5, atol=1e-7
+        )
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
+        ),
+        s1.params,
+        s2.params,
+    )
+
+
 class TestSplitStep:
     """The split (no-io_callback) CST path must match the one-graph path
     exactly: same rng -> same rollout -> same rewards -> same update."""
 
     @pytest.mark.parametrize("baseline", ["greedy", "scb"])
     def test_split_matches_one_graph(self, corpus, tmp_path, baseline):
-        import jax.numpy as jnp
-
-        from cst_captioning_tpu.data import BatchIterator
-        from cst_captioning_tpu.models import model_from_config
         from cst_captioning_tpu.training.cst import (
             _make_one_graph_step,
             _make_split_step,
         )
-        from cst_captioning_tpu.training.rewards import CiderDRewarder
-        from cst_captioning_tpu.training.steps import (
-            create_train_state,
-            make_optimizer,
-        )
 
-        ds, _ = corpus
         # chunks=1: the split rollout must replay the one-graph rollout's
         # exact rng stream (chunked dispatch folds rng per chunk).
-        cfg = cst_cfg(tmp_path, baseline, cst_score_chunks=1)
-        cfg.model.vocab_size = len(ds.vocab)
-        model = model_from_config(cfg)
-        it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
-                           shuffle=False)
-        batch = next(iter(it.epoch(0)))
-        tx = make_optimizer(cfg.train, 10)
-        rewarder = CiderDRewarder(ds)
-        rng = jax.random.PRNGKey(3)
-
-        def run(step_fn):
-            state = create_train_state(
-                jax.random.PRNGKey(0), model, tx, batch._asdict()
-            )
-            return step_fn(
-                state, batch.feats, batch.feat_masks, batch.captions,
-                batch.weights, None, batch.video_idx, rng, 0.0,
-            )
-
-        s1, m1 = run(_make_one_graph_step(model, cfg, rewarder))
-        s2, m2 = run(_make_split_step(model, cfg, rewarder))
-        for k in ("loss", "reward", "baseline"):
-            np.testing.assert_allclose(
-                float(m1[k]), float(m2[k]), rtol=1e-5, atol=1e-7
-            )
-        jax.tree.map(
-            lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
-            ),
-            s1.params,
-            s2.params,
+        cfg, model, rewarder, run = split_setup(
+            corpus, tmp_path, baseline, cst_score_chunks=1
+        )
+        assert_same_update(
+            run(_make_one_graph_step(model, cfg, rewarder)),
+            run(_make_split_step(model, cfg, rewarder)),
         )
 
     @pytest.mark.parametrize("baseline", ["greedy", "scb"])
@@ -167,49 +185,40 @@ class TestSplitStep:
         not change the step's math: at near-zero sampling temperature the
         rollout is deterministic regardless of rng, so K=1 and K>1 must
         produce identical updates."""
-        from cst_captioning_tpu.data import BatchIterator
-        from cst_captioning_tpu.models import model_from_config
         from cst_captioning_tpu.training.cst import _make_split_step
-        from cst_captioning_tpu.training.rewards import CiderDRewarder
-        from cst_captioning_tpu.training.steps import (
-            create_train_state,
-            make_optimizer,
+
+        cfg, model, rewarder, run = split_setup(
+            corpus, tmp_path, baseline, sample_temperature=1e-4
         )
 
-        ds, _ = corpus
-        cfg = cst_cfg(tmp_path, baseline, sample_temperature=1e-4)
-        cfg.model.vocab_size = len(ds.vocab)
-        model = model_from_config(cfg)
-        it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
-                           shuffle=False)
-        batch = next(iter(it.epoch(0)))
-        tx = make_optimizer(cfg.train, 10)
-        rewarder = CiderDRewarder(ds)
-        rng = jax.random.PRNGKey(3)
-
-        def run(k):
+        def at_chunks(k):
             cfg.train.cst_score_chunks = k
-            state = create_train_state(
-                jax.random.PRNGKey(0), model, tx, batch._asdict()
-            )
-            return _make_split_step(model, cfg, rewarder)(
-                state, batch.feats, batch.feat_masks, batch.captions,
-                batch.weights, None, batch.video_idx, rng, 0.0,
-            )
+            return run(_make_split_step(model, cfg, rewarder))
 
-        s1, m1 = run(1)
-        sk, mk = run(chunks)
-        for key in ("loss", "reward", "baseline"):
-            np.testing.assert_allclose(
-                float(m1[key]), float(mk[key]), rtol=1e-5, atol=1e-7
-            )
-        jax.tree.map(
-            lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6
-            ),
-            s1.params,
-            sk.params,
+        assert_same_update(at_chunks(1), at_chunks(chunks))
+
+    @pytest.mark.parametrize("baseline", ["greedy", "scb"])
+    def test_latency_gated_fused_layout_is_exact(
+        self, corpus, tmp_path, baseline, monkeypatch
+    ):
+        """High-dispatch-latency runtimes take the fused single-dispatch
+        layout (rollout + greedy in one graph) — it must produce the
+        exact same update as the low-latency two-dispatch K=1 layout
+        under the same rng."""
+        from cst_captioning_tpu.training import cst as cst_mod
+
+        cfg, model, rewarder, run = split_setup(
+            corpus, tmp_path, baseline, cst_score_chunks=1
         )
+        # Pin BOTH layouts explicitly — relying on the ambient cached
+        # latency measurement could make the first run fused too (e.g.
+        # on a loaded host) and the test would compare the fused layout
+        # against itself.
+        monkeypatch.setattr(cst_mod, "dispatch_latency_ms", lambda: 0.0)
+        fast = run(cst_mod._make_split_step(model, cfg, rewarder))
+        monkeypatch.setattr(cst_mod, "dispatch_latency_ms", lambda: 1e3)
+        gated = run(cst_mod._make_split_step(model, cfg, rewarder))
+        assert_same_update(fast, gated)
 
     def test_chunk_count_divisor_fallback(self):
         from cst_captioning_tpu.training.cst import _chunk_count

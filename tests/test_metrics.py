@@ -341,6 +341,78 @@ class TestMeteorAlignment:
         assert m == 1 and wm_h == pytest.approx(1.0)
 
 
+class TestMeteorJavaProtocol:
+    """MeteorJava's stdin/stdout protocol, tested end-to-end against a
+    mock `java` executable that speaks the meteor-1.5 -stdio protocol —
+    the wrapper (arg order, SCORE/EVAL framing, flushing, key ordering)
+    is exercised without a JRE."""
+
+    FAKE_JAVA = r"""#!/usr/bin/env python3
+import sys
+args = sys.argv
+assert "-stdio" in args and "-jar" in args, args
+for line in sys.stdin:
+    line = line.rstrip("\n")
+    if line.startswith("SCORE"):
+        parts = line.split(" ||| ")
+        refs, hyp = parts[1:-1], parts[-1]
+        h = set(hyp.split())
+        best = max(
+            len(h & set(r.split())) / max(len(set(r.split())), 1)
+            for r in refs
+        )
+        print(f"stat {best}")
+        sys.stdout.flush()
+    elif line.startswith("EVAL"):
+        parts = line.split(" ||| ")[1:]
+        segs = [float(p.split()[1]) for p in parts]
+        for s in segs:
+            print(s)
+        print(sum(segs) / len(segs))
+        sys.stdout.flush()
+"""
+
+    def test_wrapper_round_trip(self, tmp_path, monkeypatch):
+        import os
+        import stat as stat_mod
+
+        from cst_captioning_tpu.metrics.meteor import Meteor
+
+        fake = tmp_path / "java"
+        fake.write_text(self.FAKE_JAVA)
+        fake.chmod(fake.stat().st_mode | stat_mod.S_IEXEC)
+        jar = tmp_path / "meteor-1.5.jar"
+        jar.write_bytes(b"")
+        monkeypatch.setenv(
+            "PATH", f"{tmp_path}{os.pathsep}{os.environ['PATH']}"
+        )
+        monkeypatch.setenv("METEOR_JAR", str(jar))
+
+        m = Meteor()
+        try:
+            assert m.backend_name == "java"
+            gts = {
+                "b": ["a dog runs fast", "a dog sprints"],
+                "a": ["a cat sits"],
+            }
+            res = {"b": ["a dog runs fast"], "a": ["zzz qqq"]}
+            final, segs = m.compute_score(gts, res)
+            # keys sort as ("a", "b"): segment 0 is the garbage hyp,
+            # segment 1 the exact match.
+            assert segs[0] == pytest.approx(0.0)
+            assert segs[1] == pytest.approx(1.0)
+            assert final == pytest.approx(0.5)
+            # second EVAL on the same process (the wrapper keeps one
+            # subprocess alive across calls)
+            final2, _ = m.compute_score(
+                {"x": ["hello world"]}, {"x": ["hello world"]}
+            )
+            assert final2 == pytest.approx(1.0)
+        finally:
+            if m.backend_name == "java":
+                m.backend.close()
+
+
 # -------------------------------------------------------------- evaluator
 
 def test_meteor_backend_stamped():
