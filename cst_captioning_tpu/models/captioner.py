@@ -376,6 +376,28 @@ class CaptionModel(nn.Module):
             h_seq = self._output_dropout(h_seq, deterministic)
             return self._logits(h_seq)
 
+        if (
+            self.fusion == "attention"
+            and self.use_pallas_attention
+            and not use_ss
+            and self.num_layers == 1
+            and not self.shard_frames
+        ):
+            from cst_captioning_tpu.ops.pallas_attlstm import (
+                attlstm_shapes_ok,
+            )
+
+            if attlstm_shapes_ok(
+                B, self.rnn_size, self.att_hidden_size, self.embed_size
+            ):
+                # Whole-recurrence fused path (ops/pallas_attlstm.py): the
+                # T-step attention+LSTM loop runs as ONE kernel with the
+                # attention tensors VMEM-resident across time, instead of a
+                # lax.scan launching a per-step attention kernel.
+                h_seq = self._fused_attention_forward(cache, input_ids)
+                h_seq = self._output_dropout(h_seq, deterministic)
+                return self._logits(h_seq)
+
         def step(carry, tok_t):
             state, prev_sample, key = carry
             if use_ss:
@@ -455,6 +477,42 @@ class CaptionModel(nn.Module):
             wh = w[d_in:].astype(cdt)
             x = lstm_recurrence(gx, wh, True)
         return x
+
+    def _fused_attention_forward(
+        self, cache: DecodeCache, input_ids: jax.Array
+    ) -> jax.Array:
+        """Whole-recurrence attention path: batch the token-embedding and
+        static-category input GEMMs over (B, T), then run the sequential
+        attention-query + context + gate chain in the fused kernel.
+        Weight-row layout follows ``_step``'s concat order
+        [emb | ctx | cat | hidden]."""
+        from cst_captioning_tpu.ops.pallas_attlstm import attlstm_recurrence
+
+        cdt = jnp.dtype(self.compute_dtype)
+        emb = self.word_embed.astype(cdt)[input_ids]        # (B, T, E)
+        w, b = self.lstm[0]
+        E = self.embed_size
+        C = cache.cat_emb.shape[-1]
+        gx = jnp.einsum(
+            "bte,eg->btg", emb, w[:E].astype(cdt),
+            preferred_element_type=jnp.float32,
+        ) + b.astype(jnp.float32)
+        if C:
+            gx = gx + jnp.einsum(
+                "bc,cg->bg", cache.cat_emb,
+                w[2 * E : 2 * E + C].astype(cdt),
+                preferred_element_type=jnp.float32,
+            )[:, None, :]
+        return attlstm_recurrence(
+            gx,
+            w[2 * E + C :].astype(cdt),
+            w[E : 2 * E].astype(cdt),
+            self.att_wh.astype(cdt),
+            self.att_v.astype(cdt),
+            cache.att_proj,
+            cache.att_mask,
+            cache.att_vals,
+        )
 
     # --------------------------------------------------------------- decode
     def init_decode(
