@@ -100,6 +100,17 @@ class TrainConfig:
     # rollout stream for a given rng).  Values that don't divide the
     # batch fall back to the largest divisor.
     cst_score_chunks: int = 4
+    # Split-step dispatch layout (backends without io_callback):
+    #   auto     — probe per-dispatch latency once; high-latency (tunneled)
+    #              runtimes take the software-pipelined layout, low-latency
+    #              hosts the chunked-scoring layout above.
+    #   pipeline — force the pipelined layout: each call dispatches ONE
+    #              graph holding [previous step's PG update + this step's
+    #              rollout], so a step pays one dispatch round-trip instead
+    #              of two (identical math, update boundaries moved; the
+    #              trainer flushes the final pending update at epoch ends).
+    #   chunked  — force the chunked/two-dispatch layout.
+    cst_split_layout: str = "auto"
 
     optimizer: str = "adam"
     learning_rate: float = 2e-4

@@ -57,17 +57,23 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def attlstm_shapes_ok(B: int, H: int, A: int, E: int) -> bool:
+def attlstm_shapes_ok(B: int, H: int, A: int, E: int, F: int,
+                      itemsize: int = 2) -> bool:
     """Static tiling gate.  On TPU the minor (lane) dims that feed the
     MXU/VPU — A, E, and the 4H gate width — must be multiples of the
     128-lane register width (same conservative rule as
-    ``ops/pallas_attention.py``); the batch must tile by 8.  Interpret
-    mode (CPU tests) keeps only the batch-divisibility requirement."""
+    ``ops/pallas_attention.py``); the batch must tile by 8; and the
+    smallest (bt=8) backward tile's resident state must fit the VMEM
+    budget — very large frame counts F fall back to the scan path
+    instead of failing to allocate.  Interpret mode (CPU tests) keeps
+    only the batch-divisibility requirement."""
     if B < 8 or B % 8:
         return False
     if _interpret():
         return True
-    return A % 128 == 0 and E % 128 == 0 and (4 * H) % 128 == 0
+    if not (A % 128 == 0 and E % 128 == 0 and (4 * H) % 128 == 0):
+        return False
+    return _resident_bytes(8, F, A, E, H, itemsize, True) <= _VMEM_BUDGET
 
 
 def _resident_bytes(bt: int, F: int, A: int, E: int, H: int,

@@ -100,6 +100,23 @@ def _validate(cfg):
     return S, baseline_kind
 
 
+def _baseline_from(rewards: np.ndarray, greedy_scores, S: int,
+                   baseline_kind: str) -> np.ndarray:
+    """Host-side baseline shared by the split and pipelined layouts:
+    greedy-decode reward (SCST), leave-one-out rollout mean (SCB), or
+    zeros.  ``rewards`` is the (B*S,) rollout reward vector in repeated
+    row order; ``greedy_scores`` the (B,) greedy rewards (greedy only)."""
+    if baseline_kind == "greedy":
+        return np.repeat(
+            np.asarray(greedy_scores, np.float32), S, axis=0
+        )
+    if baseline_kind == "scb":
+        r = rewards.reshape(-1, S)
+        loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
+        return loo.reshape(-1).astype(np.float32)
+    return np.zeros_like(rewards)
+
+
 def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
                advantage, temperature):
     """PG loss + Adam update: re-run teacher forcing over the SAMPLED
@@ -162,6 +179,21 @@ def make_cst_train_step(
     )
     if io_callback_supported():
         return _make_one_graph_step(model, cfg, rewarder, mesh=mesh)
+    layout = getattr(cfg.train, "cst_split_layout", "auto")
+    if layout not in ("auto", "pipeline", "chunked"):
+        raise ValueError(f"unknown cst_split_layout {layout!r}")
+    use_pipeline = layout == "pipeline" or (
+        layout == "auto"
+        and dispatch_latency_ms() > _CHUNK_MAX_DISPATCH_MS
+    )
+    if use_pipeline:
+        log.warning(
+            "backend lacks io_callback support — using the PIPELINED "
+            "split CST step (one dispatch per step: previous update + "
+            "next rollout; dispatch latency %.1f ms)",
+            dispatch_latency_ms(),
+        )
+        return _make_pipelined_step(model, cfg, rewarder)
     log.warning(
         "backend lacks io_callback support — using the split CST step "
         "(jitted rollout / host scoring / jitted update)"
@@ -277,6 +309,153 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
 # Above this per-dispatch latency, chunked scoring overlap can't pay for
 # its extra dispatches (see _make_split_step docstring).
 _CHUNK_MAX_DISPATCH_MS = 5.0
+
+
+# ------------------------------------------------------- pipelined variant
+
+def _make_pipelined_step(model, cfg, rewarder) -> Callable:
+    """Software-pipelined split step for high-dispatch-latency (tunneled)
+    runtimes — VERDICT r3 #3's dispatch-tax attack.
+
+    The plain split step pays TWO dispatch round-trips per step (rollout,
+    then update) with host scoring between them; through a ~100 ms tunnel
+    the RTTs dominate the step.  Here each call dispatches ONE graph:
+
+        [apply the PREVIOUS batch's PG update] -> [rollout + greedy
+        baseline for THIS batch with the freshly-updated params]
+
+    then fetches and scores this batch's tokens, holding the resulting
+    advantage as the next call's pending update.  The parameter
+    trajectory is IDENTICAL to the unpipelined step (same updates, same
+    order, same rng; only the dispatch boundaries move) — pinned by
+    ``tests/test_cst.py::test_pipelined_layout_matches_split``.
+
+    Consequences callers must know:
+    * ``metrics['loss']/['grad_norm']`` lag one step (they describe the
+      update applied this call, i.e. the previous batch); the first call
+      returns no loss.  Reward stats are current.
+    * ``train_step.flush(state)`` applies the final pending update; the
+      trainer runs it at every epoch/preemption boundary so checkpoints,
+      eval, and ``steps_done`` accounting always see fully-applied params.
+    * The rollout and greedy baseline share one feature encode
+      (``CaptionModel.sample_with_baseline``); the PG update re-encodes
+      inside the loss so the projection/attention-key weights keep their
+      gradient — that encode is load-bearing, not redundant.
+    """
+    S, baseline_kind = _validate(cfg)
+    temperature = cfg.train.sample_temperature
+    max_len = cfg.data.max_seq_len
+    need_greedy = baseline_kind == "greedy"
+
+    def _rollout(params, feats, feat_masks, category, rng):
+        rollout, greedy = model.apply(
+            params, feats, feat_masks, rng=rng, category=category,
+            max_len=max_len, temperature=temperature, repeat=S,
+            with_greedy=need_greedy, method="sample_with_baseline",
+        )
+        greedy_tokens = (
+            greedy.tokens if need_greedy
+            else jnp.zeros((1, max_len), jnp.int32)
+        )
+        return rollout.tokens, rollout.mask, greedy_tokens
+
+    first_dispatch = jax.jit(_rollout)
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update_and_rollout(state, pfeats, pmasks, pcat, ptokens, pmask,
+                           padv, feats, feat_masks, category, rng):
+        state, loss, gnorm = _pg_update(
+            state, pfeats, pmasks, pcat, S, ptokens, pmask, padv,
+            temperature,
+        )
+        tokens, mask, greedy_tokens = _rollout(
+            state.params, feats, feat_masks, category, rng
+        )
+        return state, loss, gnorm, tokens, mask, greedy_tokens
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update_only(state, pfeats, pmasks, pcat, ptokens, pmask, padv):
+        return _pg_update(
+            state, pfeats, pmasks, pcat, S, ptokens, pmask, padv,
+            temperature,
+        )
+
+    pending: dict = {}
+    phase_ms: dict = {}
+
+    def _score(vid, tokens_np, greedy_np):
+        vid_r = np.repeat(vid, S, axis=0)
+        rewards = rewarder.score_ids(vid_r, tokens_np).astype(np.float32)
+        greedy_scores = (
+            rewarder.score_ids(vid, greedy_np) if need_greedy else None
+        )
+        return rewards, _baseline_from(
+            rewards, greedy_scores, S, baseline_kind
+        )
+
+    def train_step(state, feats, feat_masks, captions, weights, category,
+                   video_idx, rng, ss_prob):
+        vid = np.asarray(video_idx)
+        metrics = {}
+        t0 = time.perf_counter()
+        if not pending:
+            tokens, mask, greedy_tokens = first_dispatch(
+                state.params, feats, feat_masks, category, rng
+            )
+        else:
+            p = pending
+            state, loss, gnorm, tokens, mask, greedy_tokens = (
+                update_and_rollout(
+                    state, p["feats"], p["masks"], p["category"],
+                    p["tokens"], p["mask"], jnp.asarray(p["advantage"]),
+                    feats, feat_masks, category, rng,
+                )
+            )
+            metrics["loss"] = loss
+            metrics["grad_norm"] = gnorm
+        # Fetch blocks on [update + rollout] compute plus one RTT.
+        tokens_np = np.asarray(tokens)
+        greedy_np = np.asarray(greedy_tokens) if need_greedy else None
+        t1 = time.perf_counter()
+        rewards, baseline = _score(vid, tokens_np, greedy_np)
+        t2 = time.perf_counter()
+        advantage = rewards - baseline
+        pending.clear()
+        pending.update(
+            feats=feats, masks=feat_masks, category=category,
+            tokens=tokens, mask=mask, advantage=advantage,
+        )
+        phase_ms.update(
+            dispatch_and_device_ms=round((t1 - t0) * 1e3, 2),
+            host_score_ms=round((t2 - t1) * 1e3, 2),
+        )
+        # Host floats, deliberately NOT device arrays: uploading stats the
+        # host just computed would enqueue three extra transfers per step
+        # through the (possibly 100ms-RTT) transport, and every consumer
+        # (trainer accumulators, logging) wants host scalars anyway.
+        metrics.update(
+            reward=float(rewards.mean()),
+            baseline=float(baseline.mean()),
+            advantage=float(advantage.mean()),
+        )
+        return state, metrics
+
+    def flush(state):
+        """Apply the pending update (if any) -> (state, metrics|None)."""
+        if not pending:
+            return state, None
+        p = pending
+        state, loss, gnorm = update_only(
+            state, p["feats"], p["masks"], p["category"], p["tokens"],
+            p["mask"], jnp.asarray(p["advantage"]),
+        )
+        pending.clear()
+        return state, {"loss": loss, "grad_norm": gnorm}
+
+    train_step.flush = flush
+    train_step.phase_ms = phase_ms
+    train_step.layout = "pipeline"
+    return train_step
 
 
 def _chunk_count(requested: int, B: int) -> int:
@@ -445,20 +624,17 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             )
         rewards = np.concatenate(reward_parts)
 
-        if baseline_kind == "greedy":
-            base = np.concatenate([
+        greedy_scores = (
+            np.concatenate([
                 rewarder.score_ids(
                     vid[lo:hi], np.asarray(toks)
                 ).astype(np.float32)
                 for (lo, hi), toks in zip(bounds, greedy_parts)
             ])
-            baseline = np.repeat(base, S, axis=0)
-        elif baseline_kind == "scb":
-            r = rewards.reshape(B, S)
-            loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
-            baseline = loo.reshape(B * S).astype(np.float32)
-        else:
-            baseline = np.zeros_like(rewards)
+            if baseline_kind == "greedy"
+            else None
+        )
+        baseline = _baseline_from(rewards, greedy_scores, S, baseline_kind)
         advantage = rewards - baseline
 
         # Phase 3 — one PG update over the full batch.

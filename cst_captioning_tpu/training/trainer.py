@@ -407,9 +407,10 @@ class Trainer:
                 acc.setdefault(k, []).append(v)
             self._epoch_steps_done = i + 1
             nsteps += 1
-            if cfg.train.nan_check:
+            if cfg.train.nan_check and "loss" in metrics:
                 # Debug guard (SURVEY.md §5 "sanitizers"): forces a host
                 # sync per step — enable only while hunting instabilities.
+                # (The pipelined CST step's first call has no loss yet.)
                 loss_now = float(metrics["loss"])
                 if not np.isfinite(loss_now):
                     raise FloatingPointError(
@@ -419,7 +420,7 @@ class Trainer:
                     )
             if cfg.train.profile_dir:
                 self._profile_step(epoch, nsteps)
-            if nsteps % cfg.train.log_every == 0:
+            if nsteps % cfg.train.log_every == 0 and "loss" in metrics:
                 log.info(
                     "epoch %d step %d loss %.4f (%.2f steps/s)",
                     epoch, nsteps, float(metrics["loss"]),
@@ -428,6 +429,15 @@ class Trainer:
         if self._profiling:  # epoch ended before the trace window closed
             jax.profiler.stop_trace()
             self._profiling = None
+        # Pipelined CST step: apply the pending (one-step-delayed) update
+        # before anything reads the params — eval, keep-best, checkpoints,
+        # and the steps_done accounting all assume fully-applied state.
+        flush = getattr(self._train_step, "flush", None)
+        if flush is not None:
+            self.state, flush_metrics = flush(self.state)
+            if flush_metrics:
+                for k, v in flush_metrics.items():
+                    acc.setdefault(k, []).append(v)
         out = {
             f"train_{k}" if k == "loss" else k: float(
                 np.mean([float(x) for x in v])

@@ -105,9 +105,10 @@ class TestKernelParity:
         )
 
     def test_shapes_gate(self):
-        assert attlstm_shapes_ok(16, 64, 32, 48)  # interpret: divisibility
-        assert not attlstm_shapes_ok(7, 64, 32, 48)
-        assert not attlstm_shapes_ok(12, 64, 32, 48)
+        # interpret mode: only batch divisibility applies
+        assert attlstm_shapes_ok(16, 64, 32, 48, 11)
+        assert not attlstm_shapes_ok(7, 64, 32, 48, 11)
+        assert not attlstm_shapes_ok(12, 64, 32, 48, 11)
 
 
 class TestModelIntegration:
