@@ -69,6 +69,13 @@ class ModelConfig:
     # independent of the LSTM kernel; exact vs the dense math, falls back
     # off-TPU / on untileable batches (ops/pallas_attention.py).
     use_pallas_attention: bool = False
+    # Bar UNK from the decode policy (sampling, beam search, and the CST
+    # PG likelihood).  False = reference parity: the reference sampler can
+    # emit UNK, and since both sides vocab-encode references with
+    # OOV -> UNK, sampled UNKs can harvest in-loop reward from UNK-encoded
+    # reference n-grams (docs/PARITY.md; pinned by
+    # tests/test_cst.py::test_unk_reward_channel).
+    decode_suppress_unk: bool = False
     # Shard the attention-fusion frame axis over the mesh "model" axis
     # (sequence/context parallelism for long feature streams; requires
     # feature_fusion="attention" and a multi-device mesh).
@@ -81,7 +88,10 @@ class TrainConfig:
 
     train_mode: str = "xe"        # xe | wxe | cst
     # CST sub-switches (reference CST_* Makefile targets):
-    cst_baseline: str = "greedy"  # greedy (SCST/CST_MS_Greedy) | scb (CST_MS_SCB) | none (CST_GT_None)
+    # greedy (SCST/CST_MS_Greedy) | scb (CST_MS_SCB: leave-one-out rollout
+    # mean) | gt_consensus (SURVEY §3.2's alternative SCB reading: the
+    # video's mean GT-caption consensus CIDEr-D — docs/PARITY.md) | none
+    cst_baseline: str = "greedy"
     cst_num_samples: int = 20     # multinomial rollouts per video (CST_MS)
     # CST_GT_None: the "samples" are the GT captions themselves, weighted by
     # consensus — mathematically the WXE regime; train_mode="cst" with this

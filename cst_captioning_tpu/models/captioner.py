@@ -117,6 +117,9 @@ class CaptionModel(nn.Module):
     param_dtype: str = "float32"
     use_pallas: bool = False      # fused LSTM recurrence kernel fast path
     use_pallas_attention: bool = False  # fused Bahdanau attention step kernel
+    # Bar UNK from the decode policy (sampling/beam/PG likelihood).  False
+    # = reference parity; see mask_decode_logits.
+    decode_suppress_unk: bool = False
     remat: bool = False       # rematerialize the decoder scan body
     # Frame/sequence parallelism (parallel/ring.py): shard the concatenated
     # frame axis of attention fusion over ``frame_axis`` of ``frame_mesh``;
@@ -324,12 +327,25 @@ class CaptionModel(nn.Module):
         ).astype(jnp.float32)
 
     @staticmethod
-    def mask_decode_logits(logits: jax.Array) -> jax.Array:
+    def mask_decode_logits(
+        logits: jax.Array, suppress_unk: bool = False
+    ) -> jax.Array:
         """The decode-time policy never emits PAD or BOS — EOS is the only
         terminator.  Applied identically in sampling, beam search, and the
         CST policy-gradient likelihood (which must match the rollout
-        policy); teacher-forced XE logits stay unmasked."""
-        return logits.at[..., PAD_ID].set(-1e30).at[..., BOS_ID].set(-1e30)
+        policy); teacher-forced XE logits stay unmasked.
+
+        ``suppress_unk`` additionally bars UNK from the decode policy
+        (``ModelConfig.decode_suppress_unk``).  Default False = reference
+        parity: the reference's sampler can emit UNK, and because both
+        sides vocab-encode references with OOV -> UNK, a sampled UNK can
+        harvest in-loop reward from UNK-encoded reference n-grams
+        (tests/test_cst.py::test_unk_reward_channel pins the behavior;
+        docs/PARITY.md records the choice)."""
+        out = logits.at[..., PAD_ID].set(-1e30).at[..., BOS_ID].set(-1e30)
+        if suppress_unk:
+            out = out.at[..., UNK_ID].set(-1e30)
+        return out
 
     # --------------------------------------------------------------- forward
     def __call__(
@@ -536,7 +552,9 @@ class CaptionModel(nn.Module):
         """One decode step → (new state, float32 log-probs (B, V)) under
         the decode policy (PAD/BOS masked out)."""
         state, h_top = self._step(state, cache, tokens)
-        logits = self.mask_decode_logits(self._logits(h_top))
+        logits = self.mask_decode_logits(
+            self._logits(h_top), self.decode_suppress_unk
+        )
         return state, jax.nn.log_softmax(logits, axis=-1)
 
     def sample(
@@ -625,7 +643,9 @@ class CaptionModel(nn.Module):
             state, tok, finished, key = carry
             key, k = jax.random.split(key)
             state, h_top = self._step(state, cache, tok)
-            logits = self.mask_decode_logits(self._logits(h_top))
+            logits = self.mask_decode_logits(
+                self._logits(h_top), self.decode_suppress_unk
+            )
             if greedy:
                 logp = jax.nn.log_softmax(logits, axis=-1)
                 nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
@@ -723,6 +743,7 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         frame_axis="model",
         frame_batch_axis=batch_axis if shard_frames else None,
         use_pallas_attention=use_pallas_attention,
+        decode_suppress_unk=getattr(m, "decode_suppress_unk", False),
         vocab_size=m.vocab_size,
         rnn_size=m.rnn_size,
         num_layers=m.num_layers,

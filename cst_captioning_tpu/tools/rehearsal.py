@@ -557,7 +557,7 @@ def main(argv=None) -> int:
                    help="warm-start checkpoint for the first stage")
     p.add_argument("--cst-lr", type=float, default=None)
     p.add_argument("--cst-baseline", default=None,
-                   choices=[None, "greedy", "scb", "none"])
+                   choices=[None, "greedy", "scb", "gt_consensus", "none"])
     p.add_argument("--cst-temperature", type=float, default=None)
     p.add_argument("--cst-lr-decay-every", type=int, default=None,
                    help="epochs between CST lr decays (0 = constant lr)")

@@ -90,7 +90,7 @@ def io_callback_supported() -> bool:
 def _validate(cfg):
     S = max(1, cfg.train.cst_num_samples)
     baseline_kind = cfg.train.cst_baseline
-    if baseline_kind not in ("greedy", "scb", "none"):
+    if baseline_kind not in ("greedy", "scb", "none", "gt_consensus"):
         raise ValueError(f"unknown cst_baseline {baseline_kind!r}")
     if baseline_kind == "scb" and S < 2:
         raise ValueError(
@@ -101,11 +101,13 @@ def _validate(cfg):
 
 
 def _baseline_from(rewards: np.ndarray, greedy_scores, S: int,
-                   baseline_kind: str) -> np.ndarray:
+                   baseline_kind: str, gt_rows=None) -> np.ndarray:
     """Host-side baseline shared by the split and pipelined layouts:
-    greedy-decode reward (SCST), leave-one-out rollout mean (SCB), or
-    zeros.  ``rewards`` is the (B*S,) rollout reward vector in repeated
-    row order; ``greedy_scores`` the (B,) greedy rewards (greedy only)."""
+    greedy-decode reward (SCST), leave-one-out rollout mean (SCB), the
+    per-video GT-caption consensus score (the SURVEY §3.2 SCB reading;
+    ``gt_rows`` = (B,) gathered from ``CiderDRewarder.gt_consensus``),
+    or zeros.  ``rewards`` is the (B*S,) rollout reward vector in
+    repeated row order; ``greedy_scores`` the (B,) greedy rewards."""
     if baseline_kind == "greedy":
         return np.repeat(
             np.asarray(greedy_scores, np.float32), S, axis=0
@@ -114,11 +116,13 @@ def _baseline_from(rewards: np.ndarray, greedy_scores, S: int,
         r = rewards.reshape(-1, S)
         loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
         return loo.reshape(-1).astype(np.float32)
+    if baseline_kind == "gt_consensus":
+        return np.repeat(np.asarray(gt_rows, np.float32), S, axis=0)
     return np.zeros_like(rewards)
 
 
 def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
-               advantage, temperature):
+               advantage, temperature, suppress_unk=False):
     """PG loss + Adam update: re-run teacher forcing over the SAMPLED
     tokens so the graph from logits to params is differentiable (the
     rollout is decode-only).  Input = [BOS, tok_0..tok_{L-2}].  ``feats``
@@ -135,11 +139,11 @@ def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
             params, feats, feat_masks, inputs, category=category, repeat=S
         )
         # REINFORCE needs log-probs of the distribution that was actually
-        # sampled from: same PAD/BOS masking AND the same temperature
-        # scaling as the rollout policy.
-        logits = CaptionModel.mask_decode_logits(logits) / jnp.asarray(
-            temperature, jnp.float32
-        )
+        # sampled from: same PAD/BOS(/UNK) masking AND the same
+        # temperature scaling as the rollout policy.
+        logits = CaptionModel.mask_decode_logits(
+            logits, suppress_unk
+        ) / jnp.asarray(temperature, jnp.float32)
         logp = jax.nn.log_softmax(logits, axis=-1)
         tok_lp = jnp.take_along_axis(logp, tokens[..., None], axis=-1)[..., 0]
         # Post-EOS slots hold PAD (= -inf under the masked policy); zero
@@ -207,6 +211,11 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
     S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
+    gt_base = (
+        jnp.asarray(rewarder.gt_consensus())
+        if baseline_kind == "gt_consensus"
+        else None
+    )
 
     def host_score(video_idx, tokens):
         return rewarder.score_ids(video_idx, tokens).astype(np.float32)
@@ -282,6 +291,10 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
             r = rewards.reshape(B, S)
             loo = (r.sum(axis=1, keepdims=True) - r) / (S - 1)
             baseline = loo.reshape(B * S)
+        elif baseline_kind == "gt_consensus":
+            # Device gather of the startup-computed per-video GT
+            # consensus scores — no extra host crossing.
+            baseline = jnp.repeat(gt_base[video_idx], S, axis=0)
         else:
             baseline = jnp.zeros_like(rewards)
         advantage = rewards - baseline
@@ -289,6 +302,7 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
         state, loss, gnorm = _pg_update(
             state, feats, feat_masks, category, S, rollout.tokens,
             rollout.mask, advantage, temperature,
+            suppress_unk=model.decode_suppress_unk,
         )
         return state, {
             "loss": loss,
@@ -366,7 +380,7 @@ def _make_pipelined_step(model, cfg, rewarder) -> Callable:
                            padv, feats, feat_masks, category, rng):
         state, loss, gnorm = _pg_update(
             state, pfeats, pmasks, pcat, S, ptokens, pmask, padv,
-            temperature,
+            temperature, suppress_unk=model.decode_suppress_unk,
         )
         tokens, mask, greedy_tokens = _rollout(
             state.params, feats, feat_masks, category, rng
@@ -377,11 +391,15 @@ def _make_pipelined_step(model, cfg, rewarder) -> Callable:
     def update_only(state, pfeats, pmasks, pcat, ptokens, pmask, padv):
         return _pg_update(
             state, pfeats, pmasks, pcat, S, ptokens, pmask, padv,
-            temperature,
+            temperature, suppress_unk=model.decode_suppress_unk,
         )
 
     pending: dict = {}
     phase_ms: dict = {}
+
+    gt_base_np = (
+        rewarder.gt_consensus() if baseline_kind == "gt_consensus" else None
+    )
 
     def _score(vid, tokens_np, greedy_np):
         vid_r = np.repeat(vid, S, axis=0)
@@ -390,7 +408,8 @@ def _make_pipelined_step(model, cfg, rewarder) -> Callable:
             rewarder.score_ids(vid, greedy_np) if need_greedy else None
         )
         return rewards, _baseline_from(
-            rewards, greedy_scores, S, baseline_kind
+            rewards, greedy_scores, S, baseline_kind,
+            gt_rows=None if gt_base_np is None else gt_base_np[vid],
         )
 
     def train_step(state, feats, feat_masks, captions, weights, category,
@@ -490,6 +509,9 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
     need_greedy = baseline_kind == "greedy"
+    gt_base_np = (
+        rewarder.gt_consensus() if baseline_kind == "gt_consensus" else None
+    )
     k_requested = max(1, getattr(cfg.train, "cst_score_chunks", 1))
     # High-latency (tunneled) runtimes take the FUSED single-dispatch
     # layout: every extra dispatch costs a full RTT, more than any
@@ -549,6 +571,7 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
         state, loss, gnorm = _pg_update(
             state, feats, feat_masks, category, S, tokens, mask,
             advantage, temperature,
+            suppress_unk=model.decode_suppress_unk,
         )
         return state, loss, gnorm
 
@@ -634,7 +657,10 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             if baseline_kind == "greedy"
             else None
         )
-        baseline = _baseline_from(rewards, greedy_scores, S, baseline_kind)
+        baseline = _baseline_from(
+            rewards, greedy_scores, S, baseline_kind,
+            gt_rows=None if gt_base_np is None else gt_base_np[vid],
+        )
         advantage = rewards - baseline
 
         # Phase 3 — one PG update over the full batch.

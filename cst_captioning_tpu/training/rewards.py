@@ -171,6 +171,31 @@ class CiderDRewarder:
             ]
         )
 
+    def gt_consensus(self) -> np.ndarray:
+        """(num_videos,) mean leave-one-out CIDEr-D of each video's GT
+        captions, under this rewarder's df table and scale — the
+        SURVEY.md §3.2 reading of the paper's SCB baseline ("baseline
+        from GT-caption consensus scores"), in the same units as
+        ``score_ids`` rewards.  Computed once; callers cache it.
+
+        Distinct from the dataset's stored ``caption_weights``: those are
+        normalized to mean 1.0 per video for the WXE loss and are NOT in
+        reward units."""
+        from cst_captioning_tpu.metrics.cider import ciderd_score_cooked
+
+        out = np.zeros((len(self._cooked_refs),), np.float32)
+        for i, cooked in enumerate(self._cooked_refs):
+            if len(cooked) < 2:
+                continue
+            out[i] = float(np.mean([
+                ciderd_score_cooked(
+                    c, cooked[:j] + cooked[j + 1:], self.doc_freq,
+                    self.log_ref_len, use_d=self.use_d,
+                )
+                for j, c in enumerate(cooked)
+            ]))
+        return out
+
     def score_ids(
         self, video_idx: np.ndarray, token_ids: np.ndarray
     ) -> np.ndarray:
