@@ -1,0 +1,31 @@
+"""Chunked-scoring overlap simulation: smoke + shape of the evidence.
+
+The timing ASSERTIONS here are deliberately loose — CI hosts are noisy
+and the chunked math's exactness is already pinned by
+tests/test_cst.py::test_chunked_scoring_pipeline_is_exact; what this
+guards is that the simulation harness runs, reports every field the
+bench records, and injects the scorer cost it claims to."""
+
+from cst_captioning_tpu.tools.overlap_sim import simulate
+
+
+def test_simulate_reports_all_fields():
+    out = simulate(sleep_ms=8.0, chunks=2, steps=2, batch=8, rollouts=2)
+    for key in (
+        "cst_overlap_sim_dispatch_latency_ms",
+        "cst_overlap_sim_rollout_compute_ms",
+        "cst_overlap_sim_injected_scorer_ms",
+        "cst_overlap_sim_k1_step_ms",
+        "cst_overlap_sim_k2_step_ms",
+        "cst_overlap_sim_recovered_ms",
+        "cst_overlap_sim_recoverable_ms",
+        "cst_overlap_sim_recovered_frac",
+    ):
+        assert key in out, key
+    assert out["cst_overlap_sim_injected_scorer_ms"] == 8.0
+    # The injected scorer must actually cost time: both layouts' steps
+    # take at least the serialized floor of one chunk's scoring.
+    assert out["cst_overlap_sim_k1_step_ms"] >= 8.0
+    assert out["cst_overlap_sim_dispatch_latency_ms"] < 5.0, (
+        "sim must run on the in-process CPU backend"
+    )
