@@ -23,12 +23,16 @@ paper (Banerjee & Lavie 2005, §3.1) under THAT paper's constants
 (alpha=0.9, gamma=0.5, beta=3) — goldens external to this
 implementation; (2) the remaining jar delta is the matcher data:
 the vendored synonym table (``data/meteor_synonyms_en.json``, a
-caption-domain subset) is far smaller than WordNet, and METEOR-1.5's
-tuned function-word weighting (delta) is not implemented.  A token the
+caption-domain subset) is far smaller than WordNet.  A token the
 jar matches via synonymy but lite leaves unmatched shifts that
-segment's weighted P/R by at most 0.8/len.  Every ``language_eval``
-result carries a ``METEOR_backend`` stamp so jar- and lite-scored runs
-are never conflated.
+segment's weighted P/R by at most 0.8/len.  METEOR-1.5's function-word
+weighting (delta) IS implemented — ``MeteorLite.meteor15_en()`` enables
+the published tuned English configuration (alpha=0.85, beta=0.2,
+gamma=0.6, delta=0.75) with a vendored closed-class function-word list;
+the default configuration stays classic/unweighted for continuity with
+earlier rounds' stamped scores.  Every ``language_eval`` result carries
+a ``METEOR_backend`` stamp so jar- and lite-scored runs are never
+conflated.
 
 The synonym matcher loads the vendored table by default; override with
 the ``METEOR_SYNONYMS`` env var (a {word: [synonyms...]} json), or set
@@ -52,10 +56,14 @@ from cst_captioning_tpu.metrics.porter import porter_stem
 
 ALPHA = 0.85
 GAMMA = 0.6
-# Fragmentation-penalty exponent: classic METEOR's 3.0 rather than 1.5's
-# tuned beta=0.2, which over-penalizes without the jar's function-word
-# weighting (see _score_from).
+# Fragmentation-penalty exponent: classic METEOR's 3.0 by default.
+# METEOR 1.3/1.5's tuned English beta=0.2 belongs with the function-word
+# (delta) weighting it was tuned alongside — the meteor15_en() preset
+# enables both together (Denkowski & Lavie 2011/2014 English `rank`
+# parameters: alpha=0.85, beta=0.2, gamma=0.6, delta=0.75).
 FRAG_EXP = 3.0
+# METEOR 1.3/1.5 en: content-word weight delta; function words weigh 1-delta.
+DELTA_EN = 0.75
 # Match-stage weights (METEOR 1.5 en defaults for exact / stem / synonym).
 W_EXACT = 1.0
 W_STEM = 0.6
@@ -68,6 +76,21 @@ DEFAULT_SYNONYMS = os.path.join(
     "data",
     "meteor_synonyms_en.json",
 )
+# Vendored English function-word list for the delta weighting.
+DEFAULT_FUNCTION_WORDS = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "data",
+    "meteor_function_words_en.txt",
+)
+
+
+def load_function_words(path: str) -> frozenset:
+    """One word per line; ``#`` comments and blanks skipped."""
+    with open(path) as f:
+        return frozenset(
+            w.strip() for w in f
+            if w.strip() and not w.startswith("#")
+        )
 
 
 def load_synonyms(path: str) -> Dict[str, frozenset]:
@@ -113,6 +136,7 @@ def _align(
     ref: List[str],
     synonyms: Optional[Dict[str, frozenset]] = None,
     beam: int = ALIGN_BEAM,
+    word_weight=None,
 ) -> Tuple[float, float, int, int]:
     """Align hypothesis to one reference.
 
@@ -122,6 +146,12 @@ def _align(
     count, then total matcher weight, then MINIMIZE chunk count.  A
     chunk is a run of consecutive hyp positions mapped to consecutive
     ref positions; an unmatched hyp word breaks the run.
+
+    ``word_weight``: optional word -> weight map (METEOR 1.3/1.5 delta:
+    content words delta, function words 1-delta).  Each match's
+    contribution to the hyp/ref side is the matcher weight times that
+    SIDE's word weight; the alignment objective itself stays on the
+    unweighted matcher sum, as in the jar.
     """
     hyp_stem = [porter_stem(w) for w in hyp]
     ref_stem = [porter_stem(w) for w in ref]
@@ -135,27 +165,38 @@ def _align(
         cands.append(row)
 
     def rank(v):
-        m, ws, ch = v
+        m, ws, ch = v[:3]
         return (m, ws, -ch)
 
-    # state: (used_ref_bitmask, last_matched_ref_j) -> (matches, wsum, chunks)
-    states = {(0, -2): (0, 0.0, 0)}
+    # state: (used_ref_bitmask, last_matched_ref_j) ->
+    #        (matches, wsum, chunks, wsum_hyp_side, wsum_ref_side)
+    states = {(0, -2): (0, 0.0, 0, 0.0, 0.0)}
     for i in range(len(hyp)):
-        new: Dict[Tuple[int, int], Tuple[int, float, int]] = {}
+        new: Dict[Tuple[int, int], Tuple[int, float, int, float, float]] = {}
 
         def offer(key, val):
             old = new.get(key)
             if old is None or rank(val) > rank(old):
                 new[key] = val
 
-        for (mask, last_j), (m, ws, ch) in states.items():
-            offer((mask, -2), (m, ws, ch))  # leave hyp[i] unmatched
+        hw_weight = 1.0 if word_weight is None else word_weight(hyp[i])
+        for (mask, last_j), (m, ws, ch, wh, wr) in states.items():
+            offer((mask, -2), (m, ws, ch, wh, wr))  # hyp[i] unmatched
             for j, w in cands[i]:
                 if mask >> j & 1:
                     continue
+                rw_weight = (
+                    1.0 if word_weight is None else word_weight(ref[j])
+                )
                 offer(
                     (mask | (1 << j), j),
-                    (m + 1, ws + w, ch + (0 if j == last_j + 1 else 1)),
+                    (
+                        m + 1,
+                        ws + w,
+                        ch + (0 if j == last_j + 1 else 1),
+                        wh + w * hw_weight,
+                        wr + w * rw_weight,
+                    ),
                 )
         if len(new) > beam:
             new = dict(
@@ -164,22 +205,33 @@ def _align(
             )
         states = new
 
-    m, ws, ch = max(states.values(), key=rank)
+    m, ws, ch, wh, wr = max(states.values(), key=rank)
     if m == 0:
         return 0.0, 0.0, 0, 0
-    return ws, ws, m, ch
+    return wh, wr, m, ch
 
 
 def _segment_stats(hyp: List[str], refs: List[List[str]], synonyms=None,
-                   alpha=ALPHA, gamma=GAMMA, frag_exp=FRAG_EXP):
-    """Best-reference METEOR statistics for one segment."""
+                   alpha=ALPHA, gamma=GAMMA, frag_exp=FRAG_EXP,
+                   word_weight=None):
+    """Best-reference METEOR statistics for one segment.  With
+    ``word_weight``, P/R denominators are the summed word weights of the
+    hyp/ref (METEOR 1.3/1.5 delta semantics) instead of plain lengths."""
+    def total(words):
+        if word_weight is None:
+            return float(len(words))
+        return float(sum(word_weight(w) for w in words))
+
     best = None
+    lh = total(hyp)
     for ref in refs:
-        wm_h, wm_r, m, ch = _align(hyp, ref, synonyms)
-        p = wm_h / len(hyp) if hyp else 0.0
-        r = wm_r / len(ref) if ref else 0.0
+        wm_h, wm_r, m, ch = _align(hyp, ref, synonyms,
+                                   word_weight=word_weight)
+        lr = total(ref)
+        p = wm_h / lh if lh else 0.0
+        r = wm_r / lr if lr else 0.0
         score = _score_from(p, r, m, ch, alpha, gamma, frag_exp)
-        stats = (wm_h, wm_r, m, ch, len(hyp), len(ref), score)
+        stats = (wm_h, wm_r, m, ch, lh, lr, score)
         if best is None or score > best[6]:
             best = stats
     return best
@@ -202,12 +254,21 @@ class MeteorLite:
         alpha: float = ALPHA,
         gamma: float = GAMMA,
         frag_exp: float = FRAG_EXP,
+        delta: Optional[float] = None,
+        function_words_file: Optional[str] = None,
     ):
         """``synonym_file`` resolution: explicit arg > ``METEOR_SYNONYMS``
         env var > vendored caption-domain table; the literal ``"none"``
         disables the synonym matcher.  The scoring constants are
         parameters so published worked examples under OTHER METEOR
-        versions' constants can serve as external goldens."""
+        versions' constants can serve as external goldens.
+
+        ``delta``: METEOR 1.3/1.5 function-word weighting — content
+        words weigh ``delta``, function words (vendored English list, or
+        ``function_words_file``) weigh ``1 - delta``, in both the match
+        contributions and the P/R denominators.  None (default) keeps
+        the unweighted classic behavior.  Use :meth:`meteor15_en` for
+        the published English configuration."""
         synonym_file = (
             synonym_file
             or os.environ.get(METEOR_SYNONYMS_ENV, "")
@@ -221,6 +282,32 @@ class MeteorLite:
         self.alpha = alpha
         self.gamma = gamma
         self.frag_exp = frag_exp
+        self.delta = delta
+        self._word_weight = None
+        if delta is not None:
+            fw = load_function_words(
+                function_words_file or DEFAULT_FUNCTION_WORDS
+            )
+            d = float(delta)
+
+            def word_weight(w, _fw=fw, _d=d):
+                return (1.0 - _d) if w in _fw else _d
+
+            self._word_weight = word_weight
+
+    @classmethod
+    def meteor15_en(cls, **kw) -> "MeteorLite":
+        """The METEOR 1.3/1.5 tuned English ``rank`` configuration
+        (Denkowski & Lavie 2011 §4 / 2014): alpha=0.85, beta=0.2,
+        gamma=0.6, delta=0.75, exact/stem/synonym weights 1.0/0.6/0.8
+        (module defaults).  beta (the fragmentation exponent) and delta
+        were tuned TOGETHER — enabling beta=0.2 without the
+        function-word discount over-penalizes fragmentation."""
+        kw.setdefault("alpha", 0.85)
+        kw.setdefault("gamma", 0.6)
+        kw.setdefault("frag_exp", 0.2)
+        kw.setdefault("delta", DELTA_EN)
+        return cls(**kw)
 
     def compute_score(
         self, gts: Dict[str, List[str]], res: Dict[str, List[str]]
@@ -235,6 +322,7 @@ class MeteorLite:
             wm_h, wm_r, m, ch, lh, lr, score = _segment_stats(
                 hyp, refs, self.synonyms,
                 self.alpha, self.gamma, self.frag_exp,
+                word_weight=self._word_weight,
             )
             seg_scores.append(score)
             agg += np.array([wm_h, wm_r, m, ch, lh, lr])

@@ -287,6 +287,88 @@ class TestMeteorGolden:
         assert len(seg) == 2
 
 
+class TestMeteor15Delta:
+    """METEOR 1.3/1.5 function-word (delta) weighting, against values
+    derived in closed form from the published formula (Denkowski & Lavie
+    2011 §3-4: matches weighted delta for content / 1-delta for function
+    words on each side; penalty gamma*(ch/m)^beta with the tuned English
+    alpha=0.85, beta=0.2, gamma=0.6, delta=0.75)."""
+
+    def _lite(self, **kw):
+        from cst_captioning_tpu.metrics.meteor import MeteorLite
+
+        kw.setdefault("synonym_file", "none")
+        return MeteorLite.meteor15_en(**kw)
+
+    def test_identical_sentence_closed_form(self):
+        # hyp == ref = "the cat sat on the mat": 6 exact matches in one
+        # chunk -> P = R = 1, fmean = 1, penalty = 0.6 * (1/6)^0.2.
+        m = self._lite()
+        score, _ = m.compute_score(
+            {"0": ["the cat sat on the mat"]},
+            {"0": ["the cat sat on the mat"]},
+        )
+        expected = 1.0 - 0.6 * (1.0 / 6.0) ** 0.2
+        assert abs(score - expected) < 1e-9
+
+    def test_function_word_miss_discounted(self):
+        # "a" vs "the" is a FUNCTION-word miss: content words dog/runs
+        # match.  delta config: P = R = (2*0.75) / (0.25 + 2*0.75) =
+        # 0.857... vs the unweighted 2/3 — the miss costs ~3x less.
+        from cst_captioning_tpu.metrics.meteor import MeteorLite
+
+        delta = self._lite()
+        classic = MeteorLite(synonym_file="none", frag_exp=0.2)
+        gts = {"0": ["the dog runs"]}
+        res = {"0": ["a dog runs"]}
+        s_delta, _ = delta.compute_score(gts, res)
+        s_classic, _ = classic.compute_score(gts, res)
+        p_delta = (2 * 0.75) / (0.25 + 2 * 0.75)
+        assert s_delta > s_classic
+        # closed form: fmean = p (P == R), m=2 matches, ch=1 chunk.
+        fmean = p_delta
+        expected = fmean * (1 - 0.6 * (1 / 2) ** 0.2)
+        assert abs(s_delta - expected) < 1e-9
+
+    def test_content_word_miss_costs_more(self):
+        # "dog" vs "cat" is a CONTENT miss: only the/runs match ->
+        # P = R = (0.25 + 0.75) / 1.75 ~ 0.571 < unweighted 2/3.
+        from cst_captioning_tpu.metrics.meteor import MeteorLite
+
+        delta = self._lite()
+        classic = MeteorLite(synonym_file="none", frag_exp=0.2)
+        gts = {"0": ["the cat runs"]}
+        res = {"0": ["the dog runs"]}
+        s_delta, _ = delta.compute_score(gts, res)
+        s_classic, _ = classic.compute_score(gts, res)
+        assert s_delta < s_classic
+
+    def test_delta_orders_function_vs_content_miss(self):
+        # Same edit distance, different word class: the function-word
+        # miss must strictly outscore the content-word miss under delta.
+        m = self._lite()
+        s_func, _ = m.compute_score(
+            {"0": ["the dog runs"]}, {"0": ["a dog runs"]}
+        )
+        s_cont, _ = m.compute_score(
+            {"0": ["the cat runs"]}, {"0": ["the dog runs"]}
+        )
+        assert s_func > s_cont
+
+    def test_default_configuration_unchanged(self):
+        # The default MeteorLite must stay the classic unweighted scorer
+        # (delta off) so earlier rounds' stamped scores remain comparable.
+        from cst_captioning_tpu.metrics.meteor import MeteorLite
+
+        m = MeteorLite(synonym_file="none")
+        assert m.delta is None
+        score, _ = m.compute_score(
+            {"0": ["the cat sat"]}, {"0": ["the cat sat"]}
+        )
+        expected = 1.0 - 0.6 * (1.0 / 3.0) ** 3.0  # gamma=0.6, beta=3
+        assert abs(score - expected) < 1e-9
+
+
 class TestMeteorAlignment:
     """The alignment is a beam search minimizing chunks among
     max-match alignments (the jar's objective) — these are the
