@@ -144,6 +144,7 @@ def simulate(sleep_ms: float = 0.0, chunks: int = 4, steps: int = 5,
     # K=1 serializes the full sleep, K chunks can hide ~ (K-1)/K of it.
     recoverable = sleep_ms * (chunks - 1) / chunks
     recovered = (t1 - tk) * 1e3
+    frac = recovered / recoverable if recoverable > 0 else 0.0
     return {
         "cst_overlap_sim_dispatch_latency_ms": round(lat, 3),
         "cst_overlap_sim_rollout_compute_ms": round(rollout_ms, 2),
@@ -152,9 +153,7 @@ def simulate(sleep_ms: float = 0.0, chunks: int = 4, steps: int = 5,
         f"cst_overlap_sim_k{chunks}_step_ms": round(tk * 1e3, 2),
         "cst_overlap_sim_recovered_ms": round(recovered, 2),
         "cst_overlap_sim_recoverable_ms": round(recoverable, 2),
-        "cst_overlap_sim_recovered_frac": round(
-            recovered / recoverable, 3
-        ),
+        "cst_overlap_sim_recovered_frac": round(frac, 3),
     }
 
 
@@ -162,7 +161,7 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser("overlap_sim")
     p.add_argument("--sleep-ms", type=float, default=0.0,
                    help="injected scorer cost per full batch; 0 = "
-                        "auto-size to 0.8x the measured rollout compute")
+                        "auto-size to the measured rollout compute")
     p.add_argument("--chunks", type=int, default=4)
     p.add_argument("--steps", type=int, default=5)
     a = p.parse_args(argv)

@@ -176,6 +176,12 @@ def make_cst_train_step(
 
         log.info("cst_use_gt: dispatching CST_GT_None to the WXE step")
         return make_xe_train_step(model)
+    # Validate BEFORE the io_callback early return: a typo'd layout must
+    # fail on every backend, not only when the config first reaches a
+    # runtime without host callbacks.
+    layout = getattr(cfg.train, "cst_split_layout", "auto")
+    if layout not in ("auto", "pipeline", "chunked"):
+        raise ValueError(f"unknown cst_split_layout {layout!r}")
     rewarder = CiderDRewarder(
         train_ds,
         df_mode=cfg.data.idf_file or "corpus",
@@ -183,9 +189,6 @@ def make_cst_train_step(
     )
     if io_callback_supported():
         return _make_one_graph_step(model, cfg, rewarder, mesh=mesh)
-    layout = getattr(cfg.train, "cst_split_layout", "auto")
-    if layout not in ("auto", "pipeline", "chunked"):
-        raise ValueError(f"unknown cst_split_layout {layout!r}")
     use_pipeline = layout == "pipeline" or (
         layout == "auto"
         and dispatch_latency_ms() > _CHUNK_MAX_DISPATCH_MS

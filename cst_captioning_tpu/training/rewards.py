@@ -173,27 +173,40 @@ class CiderDRewarder:
 
     def gt_consensus(self) -> np.ndarray:
         """(num_videos,) mean leave-one-out CIDEr-D of each video's GT
-        captions, under this rewarder's df table and scale — the
-        SURVEY.md §3.2 reading of the paper's SCB baseline ("baseline
-        from GT-caption consensus scores"), in the same units as
-        ``score_ids`` rewards.  Computed once; callers cache it.
+        captions, under this rewarder's df table, scale, AND reference
+        weighting — the SURVEY.md §3.2 reading of the paper's SCB
+        baseline ("baseline from GT-caption consensus scores"), in the
+        same units as ``score_ids`` rewards: when the rewarder weights
+        references (``weighted_refs``), each leave-one-out score uses the
+        remaining siblings' consensus weights exactly as ``score_ids``
+        does for rollouts.  Computed once; callers cache it.
 
         Distinct from the dataset's stored ``caption_weights``: those are
         normalized to mean 1.0 per video for the WXE loss and are NOT in
         reward units."""
-        from cst_captioning_tpu.metrics.cider import ciderd_score_cooked
-
         out = np.zeros((len(self._cooked_refs),), np.float32)
         for i, cooked in enumerate(self._cooked_refs):
             if len(cooked) < 2:
                 continue
-            out[i] = float(np.mean([
-                ciderd_score_cooked(
-                    c, cooked[:j] + cooked[j + 1:], self.doc_freq,
-                    self.log_ref_len, use_d=self.use_d,
+            # Cook each reference's tf-idf vector ONCE; every
+            # leave-one-out score slices the vector list.
+            vecs = cook_refs_vec(cooked, self.doc_freq, self.log_ref_len)
+            w = (
+                None if self._ref_weights is None
+                else self._ref_weights[i]
+            )
+            scores = []
+            for j, c in enumerate(cooked):
+                loo_w = (
+                    None if w is None
+                    else np.concatenate([w[:j], w[j + 1:]])
                 )
-                for j, c in enumerate(cooked)
-            ]))
+                scores.append(ciderd_score_vec(
+                    c, vecs[:j] + vecs[j + 1:], self.doc_freq,
+                    self.log_ref_len, use_d=self.use_d,
+                    ref_weights=loo_w,
+                ))
+            out[i] = float(np.mean(scores))
         return out
 
     def score_ids(

@@ -116,6 +116,26 @@ class TestRewarder:
             assert float(opened[0, t]) < -1e29
             assert float(closed[0, t]) < -1e29
 
+    def test_gt_consensus_respects_ref_weights(self):
+        """With weighted_refs (cst_weighted_reward), the gt_consensus
+        baseline must use the same per-reference consensus weights as
+        score_ids rewards — otherwise the baseline sits on a different
+        scale than the reward it is subtracted from."""
+        ds, _ = make_synthetic_dataset(num_videos=4, max_frames=4,
+                                       max_words=8, seed=9)
+        base_uniform = CiderDRewarder(ds, backend="python").gt_consensus()
+        n0 = len(ds.references(0))
+        w0 = np.linspace(0.2, 2.0, n0).astype(np.float32)
+        ds.set_caption_weights({ds.video_id(0): w0})
+        rw = CiderDRewarder(ds, backend="python", weighted_refs=True)
+        base_weighted = rw.gt_consensus()
+        # Video 0's nonuniform weights must move its baseline; videos
+        # with uniform (ones) weights keep the uniform-mean value.
+        assert abs(base_weighted[0] - base_uniform[0]) > 1e-6
+        np.testing.assert_allclose(
+            base_weighted[1:], base_uniform[1:], rtol=1e-6
+        )
+
     def test_gt_consensus_units_match_rewards(self, corpus):
         """gt_consensus() must be in score_ids units: a rollout equal to
         a reference scores in the same range as the GT consensus."""
