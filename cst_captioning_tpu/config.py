@@ -254,6 +254,13 @@ def _preset_msrvtt_xe() -> Config:
     c.data.feature_dims = {"resnet": 2048, "c3d": 4096}
     c.data.seq_per_img = 20
     c.train.train_mode = "xe"
+    # TPU fast paths on by default for the production presets: both
+    # kernels fall back automatically off-TPU, on untileable shapes, and
+    # on multi-device meshes (model_from_config), so these flags only
+    # ever select the faster equivalent path.  The global ModelConfig
+    # defaults stay False so CPU tests don't run interpret-mode kernels.
+    c.model.use_pallas_lstm = True
+    c.model.use_pallas_attention = True
     return c
 
 
