@@ -110,6 +110,22 @@ class TestKernelParity:
         assert not attlstm_shapes_ok(7, 64, 32, 48, 11)
         assert not attlstm_shapes_ok(12, 64, 32, 48, 11)
 
+    def test_shapes_gate_tpu_rules(self, monkeypatch):
+        """On a TPU backend the gate must also enforce 128-lane minor
+        dims AND reject frame counts whose smallest backward tile busts
+        the VMEM budget (falling back to the scan path instead of
+        failing to allocate at compile time)."""
+        import cst_captioning_tpu.ops.pallas_attlstm as mod
+
+        monkeypatch.setattr(mod, "_interpret", lambda: False)
+        # Flagship shape: fits.
+        assert mod.attlstm_shapes_ok(1280, 512, 512, 512, 56, 2)
+        # Non-128-multiple lanes: rejected.
+        assert not mod.attlstm_shapes_ok(1280, 512, 192, 512, 56, 2)
+        # Very large concatenated frame axis: the bt=8 backward tile
+        # exceeds the VMEM budget -> scan fallback.
+        assert not mod.attlstm_shapes_ok(1280, 512, 512, 512, 512, 2)
+
 
 class TestModelIntegration:
     def _build(self, use_fused):
