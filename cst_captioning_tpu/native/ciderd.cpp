@@ -314,6 +314,82 @@ void score_rows(const Scorer* s, const int* video_idx, const int* tokens,
 
 }  // namespace
 
+namespace {
+
+// Leave-one-out consensus of one video: ref j scored (as a hypothesis)
+// against its siblings, mean over j.  Twin of
+// rewards.CiderDRewarder.gt_consensus()'s per-video body — same df
+// table, same optional per-ref weights renormalized over the siblings.
+void gt_consensus_rows(const Scorer* s, float* out, int begin, int end) {
+  for (int i = begin; i < end; ++i) {
+    const Video& v = s->videos[i];
+    const size_t nref = v.ref_lengths.size();
+    if (nref < 2) {  // matches the Python early-continue (score 0)
+      out[i] = 0.0f;
+      continue;
+    }
+    const bool weighted = v.weights.size() == nref;
+    double mean = 0.0;
+    std::vector<double> sims(nref);
+    for (size_t j = 0; j < nref; ++j) {
+      Counts cnts[kNGrams];
+      precook(v.refs[j], cnts);
+      RefVec hyp;
+      counts_to_vec(cnts, s->doc_freq, s->log_ref_len, &hyp);
+      sim_d_all(hyp, v, sims.data());
+      double total = 0.0;
+      if (weighted) {
+        double wsum = 0.0;
+        for (size_t r = 0; r < nref; ++r) {
+          if (r != j) wsum += v.weights[r];
+        }
+        const bool degenerate = wsum <= 1e-12;
+        for (size_t r = 0; r < nref; ++r) {
+          if (r == j) continue;
+          const double w = degenerate
+                               ? 1.0 / static_cast<double>(nref - 1)
+                               : v.weights[r] / wsum;
+          total += w * sims[r];
+        }
+        mean += total / kNGrams * 10.0;
+      } else {
+        for (size_t r = 0; r < nref; ++r) {
+          if (r != j) total += sims[r];
+        }
+        mean += total / kNGrams / static_cast<double>(nref - 1) * 10.0;
+      }
+    }
+    out[i] = static_cast<float>(mean / static_cast<double>(nref));
+  }
+}
+
+}  // namespace
+
+// Leave-one-out GT consensus for every video -> out (num_videos,)
+// float32, CIDEr-D x10 units (same scale as ciderd_score rewards).  One
+// call at CST startup for cst_baseline='gt_consensus'; threaded — at
+// MSR-VTT scale this is ~200k scorings (ADVICE r4 #3).
+void ciderd_gt_consensus(void* h, float* out) {
+  auto* s = static_cast<Scorer*>(h);
+  const int n = static_cast<int>(s->videos.size());
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  int workers = std::max(1, std::min({hw, n / 16, 16}));
+  if (workers <= 1) {
+    gt_consensus_rows(s, out, 0, n);
+    return;
+  }
+  std::vector<std::thread> pool;
+  pool.reserve(workers);
+  const int chunk = (n + workers - 1) / workers;
+  for (int w = 0; w < workers; ++w) {
+    const int begin = w * chunk;
+    const int end = std::min(n, begin + chunk);
+    if (begin >= end) break;
+    pool.emplace_back(gt_consensus_rows, s, out, begin, end);
+  }
+  for (auto& t : pool) t.join();
+}
+
 int ciderd_score(void* h, const int* video_idx, const int* tokens, int batch,
                  int max_len, float* out) {
   auto* s = static_cast<Scorer*>(h);

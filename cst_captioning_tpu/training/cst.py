@@ -188,6 +188,17 @@ def make_cst_train_step(
         weighted_refs=cfg.train.cst_weighted_reward,
     )
     if io_callback_supported():
+        if layout != "auto":
+            # The split layouts only exist for backends WITHOUT host
+            # callbacks; on io_callback-capable backends the one-graph
+            # step is strictly better (no per-step graph break), so an
+            # explicit layout request is advisory here (ADVICE r4 #1).
+            log.warning(
+                "cst_split_layout=%r ignored: backend supports "
+                "io_callback, using the one-graph CST step (split "
+                "layouts apply only to backends without host callbacks)",
+                layout,
+            )
         return _make_one_graph_step(model, cfg, rewarder, mesh=mesh)
     use_pipeline = layout == "pipeline" or (
         layout == "auto"
@@ -474,7 +485,16 @@ def _make_pipelined_step(model, cfg, rewarder) -> Callable:
         pending.clear()
         return state, {"loss": loss, "grad_norm": gnorm}
 
+    def reset():
+        """Drop the pending update WITHOUT applying it.  The trainer
+        calls this at epoch entry: after an aborted epoch (exception
+        between dispatch and flush) the held update belongs to an
+        abandoned batch and applying it to the next epoch's state would
+        corrupt the trajectory (ADVICE r4 #2)."""
+        pending.clear()
+
     train_step.flush = flush
+    train_step.reset = reset
     train_step.phase_ms = phase_ms
     train_step.layout = "pipeline"
     return train_step

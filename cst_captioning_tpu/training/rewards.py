@@ -184,6 +184,11 @@ class CiderDRewarder:
         Distinct from the dataset's stored ``caption_weights``: those are
         normalized to mean 1.0 per video for the WXE loss and are NOT in
         reward units."""
+        if self._native is not None:
+            # Threaded C++ leave-one-out (ADVICE r4 #3): at MSR-VTT scale
+            # this is ~200k scorings, a significant one-time startup cost
+            # in Python.  Parity: tests/test_native_ciderd.py.
+            return self._native.gt_consensus()
         out = np.zeros((len(self._cooked_refs),), np.float32)
         for i, cooked in enumerate(self._cooked_refs):
             if len(cooked) < 2:

@@ -355,6 +355,15 @@ class Trainer:
         uninterrupted run exactly."""
         cfg = self.cfg
         ss_prob = scheduled_sampling_prob(cfg.model, epoch)
+        # Pipelined CST step: drop any update left pending by an ABORTED
+        # previous epoch (an exception — e.g. the nan_check
+        # FloatingPointError — raised between dispatch and flush).  In the
+        # normal flow the epoch-end flush already cleared it, so this is a
+        # no-op; after an abort the stale update belongs to an abandoned
+        # trajectory and must not leak into this epoch's first call.
+        reset = getattr(self._train_step, "reset", None)
+        if reset is not None:
+            reset()
         # Plain XE ignores consensus weights (reference train_mode switch).
         use_weights = cfg.train.train_mode != "xe"
         # Device scalars accumulated without forcing a host sync per step;

@@ -159,6 +159,40 @@ class TestWeightedConsensus:
         ds._weight_override = None  # un-poison the module-scoped corpus
 
 
+class TestGtConsensus:
+    """Native leave-one-out GT consensus (ADVICE r4 #3): the rewarder
+    routes gt_consensus() through C++ when the native backend is active,
+    so the two implementations must agree exactly."""
+
+    def test_native_matches_python(self, corpus, built):
+        ds, _ = corpus
+        py = CiderDRewarder(ds, backend="python")
+        nat = CiderDRewarder(ds, backend="native")
+        assert nat.backend == "native"
+        np.testing.assert_allclose(
+            nat.gt_consensus(), py.gt_consensus(), rtol=1e-5, atol=1e-6
+        )
+
+    def test_native_matches_python_weighted(self, corpus, built):
+        ds, _ = TestWeightedConsensus.weighted_ds(corpus, seed=12)
+        try:
+            py = CiderDRewarder(ds, backend="python", weighted_refs=True)
+            nat = CiderDRewarder(ds, backend="native", weighted_refs=True)
+            assert nat.backend == "native"
+            np.testing.assert_allclose(
+                nat.gt_consensus(), py.gt_consensus(), rtol=1e-5, atol=1e-6
+            )
+        finally:
+            ds._weight_override = None  # un-poison the module-scoped corpus
+
+    def test_under_two_refs_scores_zero(self, built):
+        nat = NativeCiderD([[[5, 6, 7]], [], [[5, 6], [5, 6, 7]]])
+        out = nat.gt_consensus()
+        assert out.shape == (3,)
+        assert out[0] == 0.0 and out[1] == 0.0  # <2 refs: no consensus
+        assert out[2] > 0.0
+
+
 class TestGuards:
     def test_packing_bound_rejected(self, built):
         with pytest.raises(NativeUnavailable):
