@@ -23,7 +23,10 @@ to skip greedy/beam decode throughput, BENCH_LOADER=0 to skip the
 packed-loader assembly bench, BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
-BENCH_MATCHED=0 to skip the chunk-10 matched-baseline re-run.
+BENCH_MATCHED=0 to skip the chunk-10 matched-baseline re-run,
+ATTLSTM_SCORE_MXU=1 to route the fused attention kernel's score
+reduction through the MXU (the VERDICT r4 #6 counter-attempt — compare
+xe_attention_steps_per_sec_chip with it 0 vs 1).
 """
 
 from __future__ import annotations
@@ -337,6 +340,11 @@ def bench_cst():
     out = {
         "cst_steps_per_sec_chip": round(1.0 / dt / n_chips, 4),
         "cst_variant": variant,
+        # Whether the fused Pallas sampler (ops/pallas_sampler.py) is on
+        # the rollout path for this run (TPU-gated in model_from_config).
+        "cst_fused_sampler": bool(
+            getattr(model, "use_pallas_sampler", False)
+        ),
         # The EFFECTIVE chunk count the split step actually uses (the
         # divisor rule of _chunk_count, and 1 whenever per-dispatch
         # latency would cost more than the scoring overlap recovers —

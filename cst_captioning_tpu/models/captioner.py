@@ -658,7 +658,7 @@ class CaptionModel(nn.Module):
         if (
             zero_state
             and self.use_pallas_sampler
-            and self.fusion == "attention"
+            and self.fusion in ("attention", "meanpool")
             and self.num_layers == 1
             and not self.shard_frames
         ):
@@ -666,10 +666,12 @@ class CaptionModel(nn.Module):
                 sampler_shapes_ok,
             )
 
+            static_ctx = self.fusion != "attention"
             if sampler_shapes_ok(
                 B, self.rnn_size, self.att_hidden_size, self.embed_size,
                 cache.att_proj.shape[1],
                 jnp.dtype(self.compute_dtype).itemsize,
+                static_ctx=static_ctx,
             ):
                 return self._fused_sample(
                     cache, rng=rng, max_len=max_len, greedy=greedy,
@@ -727,8 +729,13 @@ class CaptionModel(nn.Module):
     ) -> SampleOutput:
         """Whole-recurrence fused sampling (ops/pallas_sampler.py).
         Weight-row layout follows ``_step``'s concat order
-        [emb | ctx | cat | hidden], like ``_fused_attention_forward``."""
-        from cst_captioning_tpu.ops.pallas_sampler import attlstm_sample
+        [emb | ctx | cat | hidden], like ``_fused_attention_forward``.
+        Meanpool fusion folds the static context's gate contribution
+        into ``gx_static`` and takes the attention-free kernel."""
+        from cst_captioning_tpu.ops.pallas_sampler import (
+            attlstm_sample,
+            lstm_sample,
+        )
 
         cdt = jnp.dtype(self.compute_dtype)
         w, b = self.lstm[0]
@@ -747,25 +754,45 @@ class CaptionModel(nn.Module):
         # Any PRNG impl's key -> one int32 seed word (the kernel's hash
         # stream fans it out per row/step/position).
         seed = jax.random.bits(rng, (), jnp.uint32).astype(jnp.int32)
-        toks, lps, mask = attlstm_sample(
-            gx_static,
-            w[:E].astype(cdt),
-            w[2 * E + C :].astype(cdt),
-            w[E : 2 * E].astype(cdt),
-            self.att_wh.astype(cdt),
-            self.att_v.astype(cdt),
-            cache.att_proj,
-            cache.att_mask,
-            cache.att_vals,
-            self.word_embed.astype(cdt),
-            self.logit_w.astype(cdt),
-            self.logit_b.astype(jnp.float32),
-            seed,
+        common = dict(
             max_len=max_len,
             greedy=greedy,
             temperature=temperature,
             suppress_unk=self.decode_suppress_unk,
         )
+        if self.fusion == "attention":
+            toks, lps, mask = attlstm_sample(
+                gx_static,
+                w[:E].astype(cdt),
+                w[2 * E + C :].astype(cdt),
+                w[E : 2 * E].astype(cdt),
+                self.att_wh.astype(cdt),
+                self.att_v.astype(cdt),
+                cache.att_proj,
+                cache.att_mask,
+                cache.att_vals,
+                self.word_embed.astype(cdt),
+                self.logit_w.astype(cdt),
+                self.logit_b.astype(jnp.float32),
+                seed,
+                **common,
+            )
+        else:
+            gx_static = gx_static + jnp.einsum(
+                "be,eg->bg", cache.ctx_static.astype(cdt),
+                w[E : 2 * E].astype(cdt),
+                preferred_element_type=jnp.float32,
+            )
+            toks, lps, mask = lstm_sample(
+                gx_static,
+                w[:E].astype(cdt),
+                w[2 * E + C :].astype(cdt),
+                self.word_embed.astype(cdt),
+                self.logit_w.astype(cdt),
+                self.logit_b.astype(jnp.float32),
+                seed,
+                **common,
+            )
         return SampleOutput(tokens=toks, logprobs=lps, mask=mask)
 
 

@@ -57,6 +57,25 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _score_on_mxu() -> bool:
+    """Counter-attempt knob for the ~26%-MFU attention residual (VERDICT
+    r4 #6): the per-step score reduction s = Σ_a tanh(...)·v_a is VPU
+    work (multiply + A-wide reduce over (bt, F, A)) sharing the unit
+    with the tanh itself.  With ATTLSTM_SCORE_MXU=1 the forward kernel
+    computes it as a (bt·F, A)@(A, 1) matvec on the MXU instead —
+    terrible MXU utilization (1 output column) but it frees VPU cycles
+    for the tanh if the step is VPU-bound.  Read at trace time; set
+    before the first forward.  Numerics: the matvec multiplies in
+    compute dtype with f32 accumulation vs the default's f32 multiply —
+    differences are below the parity-test tolerances (identical when
+    compute dtype is f32).  Measurement is one env var away
+    (BENCH_ATT_HIDDEN sweeps × ATTLSTM_SCORE_MXU=0/1); unmeasured this
+    round — the tunneled TPU was unreachable for the whole session."""
+    import os
+
+    return os.environ.get("ATTLSTM_SCORE_MXU", "0") == "1"
+
+
 def attlstm_shapes_ok(B: int, H: int, A: int, E: int, F: int,
                       itemsize: int = 2) -> bool:
     """Static tiling gate.  On TPU the minor (lane) dims that feed the
@@ -212,6 +231,9 @@ def _make_fwd_kernel(with_residuals: bool):
         maskf = mask_ref[:]                             # (bt, F) f32
         vals = vals_ref[:].astype(jnp.float32)          # (bt, F, E)
 
+        score_mxu = _score_on_mxu()
+        bt_, F_, A_ = proj.shape
+
         def body(tt, _):
             h = h_scr[:]
             q = jax.lax.dot_general(
@@ -220,9 +242,18 @@ def _make_fwd_kernel(with_residuals: bool):
                 preferred_element_type=jnp.float32,
             )
             th = jnp.tanh(proj + q.astype(cdt)[:, None, :])  # (bt, F, A)
-            s = jnp.sum(
-                th.astype(jnp.float32) * vvec[None, None, :], axis=-1
-            )
+            if score_mxu:
+                # Counter-attempt (see _score_on_mxu): (bt·F, A)@(A, 1)
+                # matvec on the MXU instead of a VPU multiply-reduce.
+                s = jax.lax.dot_general(
+                    th.reshape(bt_ * F_, A_), av_ref[:],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).reshape(bt_, F_)
+            else:
+                s = jnp.sum(
+                    th.astype(jnp.float32) * vvec[None, None, :], axis=-1
+                )
             s = jnp.where(maskf > 0, s, NEG_INF)
             m = jnp.max(s, axis=-1, keepdims=True)
             e = jnp.exp(s - m)

@@ -143,15 +143,21 @@ def _pick_tiles(B: int, F: int, A: int, E: int, H: int,
 
 
 def sampler_shapes_ok(B: int, H: int, A: int, E: int, F: int,
-                      itemsize: int = 2) -> bool:
+                      itemsize: int = 2, static_ctx: bool = False) -> bool:
     """Static gate, same contract as ``attlstm_shapes_ok``: lane-width
     multiples for the GEMM minor dims on real TPU, batch tiling by 8,
-    and the smallest tile must fit the VMEM budget."""
+    and the smallest tile must fit the VMEM budget.  ``static_ctx``
+    (meanpool fusion: context folded into the static gates, no
+    attention tensors) drops the A/F requirements."""
     if B < 8 or B % 8:
         return False
     if _interpret():
         return True
-    if not (A % 128 == 0 and E % 128 == 0 and (4 * H) % 128 == 0):
+    if static_ctx:
+        A, F = 0, 0
+    elif not (A % 128 == 0):
+        return False
+    if not (E % 128 == 0 and (4 * H) % 128 == 0):
         return False
     return _resident_bytes(8, F, A, E, H, 128, itemsize) <= _VMEM_BUDGET
 
@@ -175,13 +181,20 @@ def _masked_vocab(b_out, w_out, V: int, V_pad: int, suppress_unk: bool,
 # ----------------------------------------------------------------- kernel
 
 def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
-                        greedy: bool, inv_temp: float):
-    def kernel(seed_ref, gxs_ref, wx_ref, wh_ref, wctx_ref, awh_ref,
-               av_ref, proj_ref, mask_ref, vals_ref, bout_ref,
-               emb_hbm, wout_hbm,
-               tok_out, lp_out, msk_out,
-               h_scr, c_scr, fin_scr, tokv_scr, toks_smem, emb_scr,
-               wout_scr, sem_emb, sem_w, sem_tok):
+                        greedy: bool, inv_temp: float,
+                        static_ctx: bool = False):
+    def kernel(seed_ref, gxs_ref, wx_ref, wh_ref, *rest):
+        if static_ctx:
+            # Meanpool fusion: the (static) context's gate contribution
+            # is folded into gx_static outside — no attention refs.
+            (bout_ref, emb_hbm, wout_hbm, tok_out, lp_out, msk_out,
+             h_scr, c_scr, fin_scr, tokv_scr, toks_smem, emb_scr,
+             wout_scr, sem_emb, sem_w, sem_tok) = rest
+        else:
+            (wctx_ref, awh_ref, av_ref, proj_ref, mask_ref, vals_ref,
+             bout_ref, emb_hbm, wout_hbm, tok_out, lp_out, msk_out,
+             h_scr, c_scr, fin_scr, tokv_scr, toks_smem, emb_scr,
+             wout_scr, sem_emb, sem_w, sem_tok) = rest
         b = pl.program_id(0)
         t = pl.program_id(1)
         cdt = wh_ref.dtype
@@ -207,23 +220,26 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
 
         jax.lax.fori_loop(0, bt, issue, 0)
 
-        # Attention step (query = previous hidden state).
         h = h_scr[:]
-        q = jax.lax.dot_general(
-            h.astype(cdt), awh_ref[:],
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
-        th = jnp.tanh(proj_ref[:] + q.astype(cdt)[:, None, :])
-        vvec = av_ref[:].astype(jnp.float32)[:, 0]
-        s = jnp.sum(th.astype(jnp.float32) * vvec[None, None, :], axis=-1)
-        s = jnp.where(mask_ref[:] > 0, s, NEG_INF)
-        m0 = jnp.max(s, axis=-1, keepdims=True)
-        e = jnp.exp(s - m0)
-        a = e / jnp.sum(e, axis=-1, keepdims=True)
-        ctx = jnp.sum(
-            a[:, :, None] * vals_ref[:].astype(jnp.float32), axis=1
-        )
+        if not static_ctx:
+            # Attention step (query = previous hidden state).
+            q = jax.lax.dot_general(
+                h.astype(cdt), awh_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            th = jnp.tanh(proj_ref[:] + q.astype(cdt)[:, None, :])
+            vvec = av_ref[:].astype(jnp.float32)[:, 0]
+            s = jnp.sum(
+                th.astype(jnp.float32) * vvec[None, None, :], axis=-1
+            )
+            s = jnp.where(mask_ref[:] > 0, s, NEG_INF)
+            m0 = jnp.max(s, axis=-1, keepdims=True)
+            e = jnp.exp(s - m0)
+            a = e / jnp.sum(e, axis=-1, keepdims=True)
+            ctx = jnp.sum(
+                a[:, :, None] * vals_ref[:].astype(jnp.float32), axis=1
+            )
 
         def wait(i, _):
             pltpu.make_async_copy(
@@ -233,23 +249,24 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
 
         jax.lax.fori_loop(0, bt, wait, 0)
 
-        gates = (
-            gxs_ref[:].astype(jnp.float32)
-            + jax.lax.dot_general(
-                emb_scr[:], wx_ref[:],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
-            + jax.lax.dot_general(
+        # Summation order matters for exact reference parity (float adds
+        # don't reassociate): gxs + emb [+ ctx] + wh, ctx omitted in the
+        # static variant.
+        gates = gxs_ref[:].astype(jnp.float32) + jax.lax.dot_general(
+            emb_scr[:], wx_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if not static_ctx:
+            gates = gates + jax.lax.dot_general(
                 ctx.astype(cdt), wctx_ref[:],
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            + jax.lax.dot_general(
-                h.astype(cdt), wh_ref[:],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+        gates = gates + jax.lax.dot_general(
+            h.astype(cdt), wh_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         h_new, c_new = _gate_update(gates, c_scr[:])
         h_scr[:] = h_new
@@ -356,33 +373,19 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
 
 # ------------------------------------------------------------ public entry
 
-@functools.partial(
-    jax.jit,
-    static_argnames=(
-        "max_len", "greedy", "temperature", "suppress_unk"
-    ),
-)
-def attlstm_sample(
-    gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
-    att_vals, emb, w_out, b_out, seed,
-    *, max_len: int, greedy: bool, temperature: float = 1.0,
-    suppress_unk: bool = False,
-):
-    """Fused autoregressive sample from zero state.
-
-    Shapes: gx_static (B, 4H) f32 = lstm bias + static (category) gate
-    contribution; w_x (E, 4H), wh (H, 4H), w_ctx (E, 4H), att_wh (H, A),
-    att_v (A, 1), att_proj (B, F, A), att_vals (B, F, E) in compute
-    dtype; att_mask (B, F); emb (V, E) compute dtype; w_out (H, V)
-    compute dtype; b_out (V,) f32; seed () or (1,) int32.
-
-    Returns (tokens, logprobs, mask), each (B, max_len), with the exact
-    finished-row semantics of ``CaptionModel._sample_from_cache``.
-    """
+def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
+                 max_len, greedy, temperature, suppress_unk):
+    """Shared pallas_call plumbing for both fusion modes.  ``att`` is
+    ``(w_ctx, att_wh, att_v, att_proj, att_mask, att_vals)`` or None
+    for the static-context (meanpool) variant."""
+    static_ctx = att is None
     B = gx_static.shape[0]
     H = wh.shape[0]
-    F, A = att_proj.shape[1], att_proj.shape[2]
-    E = att_vals.shape[-1]
+    E = w_x.shape[0]
+    if static_ctx:
+        F = A = 0
+    else:
+        F, A = att[3].shape[1], att[3].shape[2]
     V = emb.shape[0]
     cdt = wh.dtype
     bt, Vt = _pick_tiles(B, F, A, E, H, jnp.dtype(cdt).itemsize)
@@ -404,6 +407,22 @@ def attlstm_sample(
     const2 = lambda r, w: pl.BlockSpec(  # noqa: E731
         (r, w), lambda b, t: (0, 0), memory_space=pltpu.VMEM
     )
+    att_specs, att_args = [], []
+    if not static_ctx:
+        w_ctx, att_wh, att_v, att_proj, att_mask, att_vals = att
+        att_specs = [
+            const2(E, 4 * H),                           # w_ctx
+            const2(H, A),                               # att_wh
+            const2(A, 1),                               # att_v
+            per_b(F, A),                                # att_proj
+            pl.BlockSpec((bt, F), lambda b, t: (b, 0),
+                         memory_space=pltpu.VMEM),      # att_mask
+            per_b(F, E),                                # att_vals
+        ]
+        att_args = [
+            w_ctx, att_wh, att_v, att_proj,
+            att_mask.astype(jnp.float32), att_vals,
+        ]
     toks, lps, msk = pl.pallas_call(
         _make_sample_kernel(
             bt, Vt, K, T, V_pad, bool(greedy),
@@ -412,6 +431,7 @@ def attlstm_sample(
             # logprobs agree regardless of which backend the shape gate
             # picks.
             1.0 if greedy else 1.0 / float(temperature),
+            static_ctx=static_ctx,
         ),
         grid=grid,
         in_specs=[
@@ -420,13 +440,7 @@ def attlstm_sample(
                          memory_space=pltpu.VMEM),      # gx_static
             const2(E, 4 * H),                           # w_x
             const2(H, 4 * H),                           # wh
-            const2(E, 4 * H),                           # w_ctx
-            const2(H, A),                               # att_wh
-            const2(A, 1),                               # att_v
-            per_b(F, A),                                # att_proj
-            pl.BlockSpec((bt, F), lambda b, t: (b, 0),
-                         memory_space=pltpu.VMEM),      # att_mask
-            per_b(F, E),                                # att_vals
+            *att_specs,
             const2(1, V_pad),                           # bias
             pl.BlockSpec(memory_space=pl.ANY),          # emb (HBM)
             pl.BlockSpec(memory_space=pl.ANY),          # w_out (HBM)
@@ -452,8 +466,7 @@ def attlstm_sample(
         interpret=_interpret(),
     )(
         jnp.asarray(seed, jnp.int32).reshape((1,)),
-        gx_static, w_x, wh, w_ctx, att_wh, att_v,
-        att_proj, att_mask.astype(jnp.float32), att_vals,
+        gx_static, w_x, wh, *att_args,
         bias[None, :], emb, w_out_p,
     )
     return (
@@ -463,7 +476,74 @@ def attlstm_sample(
     )
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_len", "greedy", "temperature", "suppress_unk"
+    ),
+)
+def attlstm_sample(
+    gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
+    att_vals, emb, w_out, b_out, seed,
+    *, max_len: int, greedy: bool, temperature: float = 1.0,
+    suppress_unk: bool = False,
+):
+    """Fused autoregressive sample from zero state (attention fusion).
+
+    Shapes: gx_static (B, 4H) f32 = lstm bias + static (category) gate
+    contribution; w_x (E, 4H), wh (H, 4H), w_ctx (E, 4H), att_wh (H, A),
+    att_v (A, 1), att_proj (B, F, A), att_vals (B, F, E) in compute
+    dtype; att_mask (B, F); emb (V, E) compute dtype; w_out (H, V)
+    compute dtype; b_out (V,) f32; seed () or (1,) int32.
+
+    Returns (tokens, logprobs, mask), each (B, max_len), with the exact
+    finished-row semantics of ``CaptionModel._sample_from_cache``.
+    """
+    return _sample_impl(
+        gx_static, w_x, wh,
+        (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
+        emb, w_out, b_out, seed,
+        max_len, greedy, temperature, suppress_unk,
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "max_len", "greedy", "temperature", "suppress_unk"
+    ),
+)
+def lstm_sample(
+    gx_static, w_x, wh, emb, w_out, b_out, seed,
+    *, max_len: int, greedy: bool, temperature: float = 1.0,
+    suppress_unk: bool = False,
+):
+    """Static-context (meanpool-fusion) fused sample: the per-row
+    context and category gate contributions are already folded into
+    ``gx_static``, so each step is gather + two GEMMs + gate update +
+    streamed vocab sampling — no attention block.  Same semantics and
+    return contract as :func:`attlstm_sample`."""
+    return _sample_impl(
+        gx_static, w_x, wh, None, emb, w_out, b_out, seed,
+        max_len, greedy, temperature, suppress_unk,
+    )
+
+
 # ------------------------------------------------------- pure-XLA reference
+
+def lstm_sample_scan(
+    gx_static, w_x, wh, emb, w_out, b_out, seed,
+    *, max_len: int, greedy: bool, temperature: float = 1.0,
+    suppress_unk: bool = False,
+):
+    """Pure-XLA twin of :func:`lstm_sample` (static-context variant)."""
+    return attlstm_sample_scan(
+        gx_static, w_x, wh, None, None, None, None, None, None,
+        emb, w_out, b_out, seed,
+        max_len=max_len, greedy=greedy, temperature=temperature,
+        suppress_unk=suppress_unk,
+    )
+
 
 def attlstm_sample_scan(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
@@ -476,15 +556,20 @@ def attlstm_sample_scan(
     compare token sequences exactly.  The kernel tiles the vocab in
     ``Vt``-wide chunks; this reference computes the same quantities
     globally, which agrees because max/argmax are tile-order invariant
-    and the bias masking is identical."""
+    and the bias masking is identical.  ``att_proj is None`` selects the
+    static-context variant (use :func:`lstm_sample_scan`)."""
     B = gx_static.shape[0]
     V = emb.shape[0]
     cdt = wh.dtype
+    E = w_x.shape[0]
+    if att_proj is None:
+        F = A = 0
+    else:
+        F, A = att_proj.shape[1], att_proj.shape[2]
     # The kernel's counter uses the PADDED vocab width and mixes its seed
     # word per batch TILE; reproduce both via the same tile picker.
     bt, Vt = _pick_tiles(
-        B, att_proj.shape[1], att_proj.shape[2], att_vals.shape[-1],
-        wh.shape[0], jnp.dtype(cdt).itemsize,
+        B, F, A, E, wh.shape[0], jnp.dtype(cdt).itemsize,
     )
     V_pad = -(-V // Vt) * Vt
     bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
@@ -496,40 +581,45 @@ def attlstm_sample_scan(
         seed_arr.astype(jnp.uint32)
         + jnp.uint32(0x9E3779B9) * ((rows // bt) * bt).astype(jnp.uint32)
     )  # (B,)
-    maskf = att_mask.astype(jnp.float32)
-    vvec = att_v.astype(jnp.float32)[:, 0]
+    static_ctx = att_proj is None
+    if not static_ctx:
+        maskf = att_mask.astype(jnp.float32)
+        vvec = att_v.astype(jnp.float32)[:, 0]
     inv_temp = jnp.float32(1.0 if greedy else 1.0 / float(temperature))
     cols = jnp.arange(V_pad, dtype=jnp.int32)
 
     def step2(carry, t):
         h, c, fin, tok = carry
-        q = jax.lax.dot_general(
-            h.astype(cdt), att_wh,
+        # Gate sum order mirrors the kernel exactly (see its comment).
+        gates = gx_static.astype(jnp.float32) + jax.lax.dot_general(
+            emb[tok].astype(cdt), w_x,
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        th = jnp.tanh(att_proj + q.astype(cdt)[:, None, :])
-        s = jnp.sum(th.astype(jnp.float32) * vvec[None, None, :], axis=-1)
-        s = jnp.where(maskf > 0, s, NEG_INF)
-        a = jax.nn.softmax(s, axis=-1)
-        ctx = jnp.sum(a[:, :, None] * att_vals.astype(jnp.float32), axis=1)
-        gates = (
-            gx_static.astype(jnp.float32)
-            + jax.lax.dot_general(
-                emb[tok].astype(cdt), w_x,
+        if not static_ctx:
+            q = jax.lax.dot_general(
+                h.astype(cdt), att_wh,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            + jax.lax.dot_general(
+            th = jnp.tanh(att_proj + q.astype(cdt)[:, None, :])
+            s = jnp.sum(
+                th.astype(jnp.float32) * vvec[None, None, :], axis=-1
+            )
+            s = jnp.where(maskf > 0, s, NEG_INF)
+            a = jax.nn.softmax(s, axis=-1)
+            ctx = jnp.sum(
+                a[:, :, None] * att_vals.astype(jnp.float32), axis=1
+            )
+            gates = gates + jax.lax.dot_general(
                 ctx.astype(cdt), w_ctx,
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-            + jax.lax.dot_general(
-                h.astype(cdt), wh,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )
+        gates = gates + jax.lax.dot_general(
+            h.astype(cdt), wh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         h_new, c_new = _gate_update(gates, c)
         logits = (

@@ -10,7 +10,11 @@ from cst_captioning_tpu.tools.overlap_sim import simulate
 
 
 def test_simulate_reports_all_fields():
-    out = simulate(sleep_ms=8.0, chunks=2, steps=2, batch=8, rollouts=2)
+    out = simulate(
+        sleep_ms=8.0, chunks=2, steps=2, batch=8, rollouts=2, reps=2
+    )
+    assert out["cst_overlap_sim_reps"] == 2
+    assert "cst_overlap_sim_recovered_ms_sd" in out
     for key in (
         "cst_overlap_sim_dispatch_latency_ms",
         "cst_overlap_sim_rollout_compute_ms",

@@ -18,6 +18,8 @@ from cst_captioning_tpu.models.captioner import CaptionModel
 from cst_captioning_tpu.ops.pallas_sampler import (
     attlstm_sample,
     attlstm_sample_scan,
+    lstm_sample,
+    lstm_sample_scan,
     sampler_shapes_ok,
 )
 
@@ -108,6 +110,68 @@ class TestKernelVsReference:
         a = attlstm_sample(*args.values(), 1, max_len=10, greedy=False)
         b = attlstm_sample(*args.values(), 2, max_len=10, greedy=False)
         assert np.any(np.asarray(a[0]) != np.asarray(b[0]))
+
+
+class TestStaticCtxVariant:
+    """The meanpool (static-context) kernel variant: no attention block,
+    context folded into gx_static outside."""
+
+    @staticmethod
+    def static_args(B=8, H=16, E=16, V=60, seed=31):
+        a = make_args(B=B, H=H, E=E, V=V, seed=seed)
+        return {
+            k: a[k] for k in ("gx_static", "w_x", "wh", "emb", "w_out",
+                              "b_out")
+        }
+
+    @pytest.mark.parametrize("greedy", [True, False])
+    def test_exact_parity(self, greedy):
+        args = self.static_args()
+        k = lstm_sample(*args.values(), 11, max_len=10, greedy=greedy)
+        r = lstm_sample_scan(*args.values(), 11, max_len=10, greedy=greedy)
+        np.testing.assert_array_equal(np.asarray(k[0]), np.asarray(r[0]))
+        np.testing.assert_allclose(
+            np.asarray(k[1]), np.asarray(r[1]), rtol=1e-5, atol=1e-5
+        )
+        np.testing.assert_array_equal(np.asarray(k[2]), np.asarray(r[2]))
+
+    def test_captioner_meanpool_greedy_matches_scan(self):
+        def build(use_sampler, B=8, V=40, F=3):
+            kw = dict(
+                vocab_size=V, rnn_size=16, embed_size=16,
+                att_hidden_size=16, num_layers=1, fusion="meanpool",
+                modalities=("resnet",), feature_dims=(12,),
+                compute_dtype="float32",
+            )
+            model = CaptionModel(use_pallas_sampler=use_sampler, **kw)
+            rng = np.random.RandomState(8)
+            feats = {
+                "resnet": jnp.asarray(rng.randn(B, F, 12), jnp.float32)
+            }
+            masks = {"resnet": jnp.ones((B, F), jnp.float32)}
+            ids = jnp.asarray(
+                rng.randint(4, V, size=(B, 6)), jnp.int32
+            ).at[:, 0].set(BOS_ID)
+            params = CaptionModel(**kw).init(
+                jax.random.PRNGKey(1), feats, masks, ids
+            )
+            return model, params, feats, masks
+
+        fused, params, feats, masks = build(True)
+        scan, *_ = build(False)
+        out_f = fused.apply(
+            params, feats, masks, max_len=9, greedy=True, method="sample"
+        )
+        out_s = scan.apply(
+            params, feats, masks, max_len=9, greedy=True, method="sample"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(out_f.tokens), np.asarray(out_s.tokens)
+        )
+        np.testing.assert_allclose(
+            np.asarray(out_f.logprobs), np.asarray(out_s.logprobs),
+            rtol=1e-4, atol=1e-5,
+        )
 
 
 class TestSemantics:
