@@ -77,6 +77,14 @@ class ModelConfig:
     # model_from_config additionally gates this on a real TPU backend
     # (interpret mode would crawl) and single-device meshes.
     use_pallas_sampler: bool = False
+    # Whole-recurrence fused BEAM-SEARCH kernel (ops/pallas_beam.py): the
+    # eval/validation beam decode as ONE kernel — attention tensors
+    # VMEM-resident across steps, vocab projection streamed in V-tiles
+    # with an online per-beam top-K (no (B*K, V) logits array), beam
+    # reorder in-kernel.  Token-exact vs decoding/beam.py at float32;
+    # tie-order contract in docs/PARITY.md.  model_from_config gates it
+    # on a real TPU backend and single-device meshes like the sampler.
+    use_pallas_beam: bool = False
     # Bar UNK from the decode policy (sampling, beam search, and the CST
     # PG likelihood).  False = reference parity: the reference sampler can
     # emit UNK, and since both sides vocab-encode references with
@@ -274,6 +282,7 @@ def _preset_msrvtt_xe() -> Config:
     c.model.use_pallas_lstm = True
     c.model.use_pallas_attention = True
     c.model.use_pallas_sampler = True
+    c.model.use_pallas_beam = True
     return c
 
 

@@ -10,5 +10,7 @@ beam dimension — no dynamic shapes, runs under ``jit``/``pjit``
 from cst_captioning_tpu.decoding.beam import (  # noqa: F401
     BeamResult,
     beam_search,
+    finalize_beams,
+    fused_beam_engaged,
     make_beam_search_fn,
 )

@@ -17,17 +17,26 @@ TPU-first design (NOT the reference's per-video Python loop):
 * Length normalization (divide by token count) is applied once at
   finalize, matching the common beam length-penalty choice; toggleable via
   ``length_normalize`` (``EvalConfig.length_normalize``).
+
+Fused fast path: when the model requests ``use_pallas_beam`` and the
+shapes pass ``beam_shapes_ok``, the whole recurrence dispatches to the
+fused Pallas kernel (``ops/pallas_beam.py``) instead of the per-step
+scan — same semantics, same :func:`finalize_beams` epilogue, declared
+token-exact at float32 (docs/PARITY.md records the tie-order contract).
 """
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple
+from typing import Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
-from cst_captioning_tpu.models.captioner import CaptionModel
+from cst_captioning_tpu.models.captioner import (
+    CaptionModel,
+    warn_fused_decline,
+)
 
 NEG_INF = -1e30
 
@@ -37,6 +46,68 @@ class BeamResult(NamedTuple):
     score: jax.Array        # (B,) float32 — its (normalized) log-prob
     all_tokens: jax.Array   # (B, K, L) int32 — full beam, best-first
     all_scores: jax.Array   # (B, K) float32
+
+
+def finalize_beams(
+    seqs: jax.Array,
+    scores: jax.Array,
+    length_normalize: bool = True,
+) -> BeamResult:
+    """Shared epilogue of BOTH beam backends: length-normalize (divide
+    by token count) and order best-first.  ``seqs`` (B, K, L) int32,
+    ``scores`` (B, K) float32 — the raw end-of-scan beam state."""
+    if length_normalize:
+        lengths = jnp.maximum((seqs != PAD_ID).sum(-1), 1)     # (B, K)
+        final = scores / lengths.astype(jnp.float32)
+    else:
+        final = scores
+    order = jnp.argsort(-final, axis=-1)                       # best-first
+    batch_ix = jnp.arange(seqs.shape[0])[:, None]
+    all_tokens = seqs[batch_ix, order]
+    all_scores = final[batch_ix, order]
+    return BeamResult(
+        tokens=all_tokens[:, 0],
+        score=all_scores[:, 0],
+        all_tokens=all_tokens,
+        all_scores=all_scores,
+    )
+
+
+def fused_beam_engaged(
+    model: CaptionModel,
+    feats,
+    beam_size: int,
+) -> Tuple[bool, str]:
+    """Whether the fused beam kernel will take this decode — the shape/
+    config gate shared by :func:`beam_search` (dispatch), evaluation.py
+    (engagement log) and bench.py (the ``beam_fused`` extra).  Returns
+    ``(engaged, reason-when-not)``; purely static, safe under trace."""
+    if not getattr(model, "use_pallas_beam", False):
+        return False, "use_pallas_beam off"
+    if model.fusion not in ("attention", "meanpool"):
+        return False, f"fusion={model.fusion!r}"
+    if model.num_layers != 1 or model.shard_frames:
+        return False, (
+            f"num_layers={model.num_layers}, "
+            f"shard_frames={model.shard_frames} (kernel covers "
+            "single-layer unsharded decoders)"
+        )
+    from cst_captioning_tpu.ops.pallas_beam import beam_shapes_ok
+
+    B = feats[model.modalities[0]].shape[0]
+    F = sum(feats[m].shape[1] for m in model.modalities)
+    ok = beam_shapes_ok(
+        B, beam_size, model.vocab_size, model.rnn_size,
+        model.att_hidden_size, model.embed_size, F,
+        jnp.dtype(model.compute_dtype).itemsize,
+        static_ctx=model.fusion != "attention",
+    )
+    if not ok:
+        return False, (
+            f"shape gate: B={B}, K={beam_size}, V={model.vocab_size}, "
+            f"F={F} fails beam_shapes_ok"
+        )
+    return True, ""
 
 
 def beam_search(
@@ -53,6 +124,17 @@ def beam_search(
     """Run beam search for a batch of videos.  Pure function of arrays —
     safe to wrap in ``jit`` (see :func:`make_beam_search_fn`)."""
     K = beam_size
+    engaged, reason = fused_beam_engaged(model, feats, K)
+    if engaged:
+        # Whole-recurrence fused kernel (ops/pallas_beam.py): no
+        # per-step launches, no (B*K, V) logits materialization.
+        seqs, scores = model.apply(
+            params, feats, feat_masks, category,
+            beam_size=K, max_len=max_len, method="fused_beam",
+        )
+        return finalize_beams(seqs, scores, length_normalize)
+    if getattr(model, "use_pallas_beam", False):
+        warn_fused_decline("use_pallas_beam", reason)
     state, cache = model.apply(
         params, feats, feat_masks, category, method="init_decode"
     )
@@ -110,22 +192,7 @@ def beam_search(
         (state, seqs0, scores0, finished0, tokens0),
         jnp.arange(max_len),
     )
-
-    if length_normalize:
-        lengths = jnp.maximum((seqs != PAD_ID).sum(-1), 1)     # (B, K)
-        final = scores / lengths.astype(jnp.float32)
-    else:
-        final = scores
-    order = jnp.argsort(-final, axis=-1)                       # best-first
-    batch_ix = jnp.arange(B)[:, None]
-    all_tokens = seqs[batch_ix, order]
-    all_scores = final[batch_ix, order]
-    return BeamResult(
-        tokens=all_tokens[:, 0],
-        score=all_scores[:, 0],
-        all_tokens=all_tokens,
-        all_scores=all_scores,
-    )
+    return finalize_beams(seqs, scores, length_normalize)
 
 
 def make_beam_search_fn(

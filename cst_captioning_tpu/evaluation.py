@@ -118,6 +118,27 @@ def beam_decode_dataset(
     cfg: Config,
 ) -> Dict[str, str]:
     """Beam-decode every video once -> {video_id: caption}."""
+    if getattr(model, "use_pallas_beam", False):
+        # Engagement visibility: whether THIS eval pays per-step scan
+        # orchestration or the fused kernel (the dispatch itself lives
+        # in decoding/beam.py; batch shape decides, so probe at the
+        # configured batch size).
+        import logging
+
+        from cst_captioning_tpu.decoding.beam import fused_beam_engaged
+
+        probe = {
+            m: np.zeros((cfg.data.batch_size, cfg.data.max_frames, 1))
+            for m in model.modalities
+        }
+        engaged, reason = fused_beam_engaged(
+            model, probe, cfg.eval.beam_size
+        )
+        logging.getLogger("cst_captioning_tpu.eval").info(
+            "beam decode backend: %s",
+            "fused Pallas kernel" if engaged
+            else f"lax.scan ({reason})",
+        )
     beam_fn = make_beam_search_fn(
         model,
         beam_size=cfg.eval.beam_size,

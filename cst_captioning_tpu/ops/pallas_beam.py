@@ -1,0 +1,710 @@
+"""Fused whole-recurrence BEAM-SEARCH decode as one Pallas TPU kernel.
+
+Why this exists (VERDICT r5 #7 / next-round item: "extend the fused
+sampler to beam search"): CST training needs constant validation
+decoding — beam-5 over every val/test video each eval epoch (reference
+``sample.py``/``test.py``, SURVEY.md §2 "Beam search", §3.3) — yet eval
+decode was the last autoregressive hot loop still running as a per-step
+``lax.scan``: ~max_len × (kernel launch + HBM carry round-trip + a full
+(B·K, V) vocab GEMM whose logits materialize only to be top-K'd).  That
+is exactly the per-iteration orchestration tax the fused sampler kernel
+(``ops/pallas_sampler.py``) removed from the CST rollout; this module
+generalizes the same kernel architecture from argmax/Gumbel-max to the
+full beam recurrence:
+
+* Grid is ``(video_tiles, time)`` with time innermost.  The beam grid is
+  flattened to ``R = B*K`` rows (video-major, row ``r = video*K + k`` —
+  the same layout as ``decoding/beam.py``'s flat state axis); per-video
+  tensors are expanded K× OUTSIDE the kernel so every in-kernel tensor
+  is row-uniform.  Attention tensors stay VMEM-resident across all
+  decode steps; the ``(h, c)`` beam states live in VMEM scratch.
+* Each step gathers the just-selected beam tokens' embedding rows
+  straight from the HBM-resident table with per-row async DMAs (indices
+  staged through SMEM), overlapped with the attention math — identical
+  to the sampler's feed path.
+* The vocab projection streams ``w_out`` (H, V) from HBM in
+  double-buffered V-tiles with an **online per-beam top-K reduction**:
+  each tile's top-K (by logit, ties to the lowest vocab id) is merged
+  into a running per-row top-K while the log-sum-exp accumulates
+  online — no ``(B·K, V)`` logits array ever materializes.
+* Beam selection happens IN-KERNEL: per-row candidates become
+  ``score + log_softmax`` totals with flat keys ``k*V + v``; the K rows
+  of a video contribute K candidates each and the video's next beam is
+  the top-K of that K·K union by ``(total desc, flat key asc)`` — the
+  exact ordering of ``jax.lax.top_k`` over the scan path's flattened
+  ``(B, K*V)`` total array (any global top-K element is necessarily
+  inside its row's top-K, so the union loses nothing; docs/PARITY.md
+  "beam tie-breaking").  Beam reordering (hypothesis buffer, ``h``/``c``
+  states, finished flags) is a one-hot parent reduction in-kernel; the
+  selected tokens are staged through SMEM for the next step's embedding
+  gather.
+* EOS freeze/collapse and length-normalization semantics match
+  ``decoding/beam.py`` exactly: a finished beam's candidate row
+  collapses to ``[(PAD, score), (v, NEG_INF)...]`` at zero cost, PAD
+  feeds back as EOS so the next embedding gather is defined, and
+  length-normalize + best-first ordering happen in the shared finalize
+  OUTSIDE the kernel (``decoding/beam.py::finalize_beams``).
+
+Numerics/parity contract: at float32 the kernel is BIT-EXACT against
+its pure-XLA twin ``attlstm_beam_scan`` (which mirrors the kernel's
+decomposed GEMM order and V-tile-chunked log-sum-exp accumulation), and
+token-exact against ``decoding/beam.py``'s scan path on the test suite's
+fixed seeds (pinned by tests/test_pallas_beam.py).  The one residual
+daylight vs the scan path is float addition order: the scan path's
+single-pass ``log_softmax`` sum and its fused ``[x, h] @ W`` gate GEMM
+associate differently at the last ulp, so a candidate pair whose totals
+differ by <1 ulp at the top-K boundary could in principle resolve
+differently — structural ties (identical beams at t=0, frozen-beam
+NEG_INF padding, duplicated vocab rows) are exact in both paths and
+resolve identically by flat-key order.  docs/PARITY.md records this.
+
+Scope: single-layer attention-fusion or meanpool decoders decoding from
+zero state — the flagship eval configs.  Gated by ``beam_shapes_ok``
+(and TPU-backend-gated in ``model_from_config``); every decline falls
+back to the scan path with identical semantics.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.ops.pallas_lstm import _gate_update
+from cst_captioning_tpu.ops.pallas_sampler import _interpret, _masked_vocab
+
+NEG_INF = -1e30
+# Sentinel strictly below any real candidate (live totals are > -2e30;
+# running-top-K slots start here so the first tile evicts them all).
+_F32_MIN = np.float32(-3.0e38)
+_REMOVED = np.float32(-np.inf)
+
+
+# ------------------------------------------------------------ shape gating
+
+def _resident_bytes(btv: int, K: int, F: int, A: int, E: int, H: int,
+                    Vt: int, L: int, itemsize: int) -> int:
+    """Rough VMEM footprint of the beam kernel at ``btv`` videos/tile."""
+    rt = btv * K
+    att = rt * F * (A + E) * itemsize            # att_proj + att_vals
+    weights = (H + 2 * E) * 4 * H * itemsize + H * A * itemsize
+    wout = 2 * H * Vt * itemsize                 # double-buffered tiles
+    gx = rt * 4 * H * 4                          # gx_static block (f32)
+    emb = rt * E * itemsize
+    state = 2 * rt * H * 4
+    seqs = rt * L * 4                            # hypothesis buffer (i32)
+    return att + weights + wout + gx + emb + state + seqs
+
+
+# Separate (env-tunable) budget from the sampler's: the beam kernel
+# carries K× the per-video state plus the hypothesis buffer, and has not
+# been calibrated on hardware — start conservative (VERDICT r5 weak #2's
+# lesson applies here too: sweep on the first hardware session).
+_VMEM_BUDGET = int(
+    float(os.environ.get("CST_BEAM_VMEM_MB", "14")) * 1024 * 1024
+)
+
+
+def _pick_tiles(B: int, K: int, F: int, A: int, E: int, H: int,
+                L: int, itemsize: int) -> Tuple[int, int]:
+    """(btv, Vt) — largest video tile that fits, then the V-tile width."""
+    for Vt in (512, 256, 128):
+        for btv in (16, 8, 4, 2, 1):
+            if B % btv:
+                continue
+            if _resident_bytes(
+                btv, K, F, A, E, H, Vt, L, itemsize
+            ) <= _VMEM_BUDGET:
+                return btv, Vt
+    return 1, 128
+
+
+def beam_shapes_ok(B: int, K: int, V: int, H: int, A: int, E: int, F: int,
+                   itemsize: int = 2, static_ctx: bool = False) -> bool:
+    """Static gate, same contract as ``sampler_shapes_ok``: the beam
+    union argument needs ≥ K live candidates per row, so the vocab must
+    exceed K plus the masked specials; lane-width multiples apply to the
+    GEMM minor dims on real TPU; the smallest tile must fit the VMEM
+    budget.  ``static_ctx`` (meanpool) drops the A/F requirements."""
+    if K < 1 or V < K + 4:
+        return False
+    if B < 1:
+        return False
+    if _interpret():
+        return True
+    if B < 4 or B % 4:
+        return False
+    if static_ctx:
+        A, F = 0, 0
+    elif A % 128 != 0:
+        return False
+    if not (E % 128 == 0 and (4 * H) % 128 == 0):
+        return False
+    return _resident_bytes(
+        1, K, F, A, E, H, 128, 32, itemsize
+    ) <= _VMEM_BUDGET
+
+
+# ------------------------------------------------- shared top-K reduction
+
+def _row_topk(values, ids, k: int):
+    """Per-row top-``k`` by ``(value desc, id asc)`` — the ordering of
+    ``jax.lax.top_k`` over values keyed by ascending ``ids``.  ``values``
+    (R, W) f32, ``ids`` (R, W) int32 with row-unique ids.  Returns
+    ((R, k) values, (R, k) ids).  Shared verbatim by the kernel and the
+    pure-XLA twin so both sides resolve ties identically."""
+    big = jnp.int32(2**30)
+    vals, sel_ids = [], []
+    work = values
+    for _ in range(k):
+        m = jnp.max(work, axis=-1, keepdims=True)
+        sel = jnp.min(
+            jnp.where(work == m, ids, big), axis=-1, keepdims=True
+        )
+        vals.append(m)
+        sel_ids.append(sel)
+        work = jnp.where(ids == sel, _REMOVED, work)
+    return jnp.concatenate(vals, -1), jnp.concatenate(sel_ids, -1)
+
+
+def _merge_topk(run_v, run_i, tile_v, tile_i, k: int):
+    """Merge a tile's top-k into the running top-k (both (R, k)).  Tile
+    ids are strictly greater than all running ids (tiles stream in
+    ascending vocab order), so ``(value desc, id asc)`` over the
+    concatenation reproduces a full-vocab top-k's tie behavior."""
+    return _row_topk(
+        jnp.concatenate([run_v, tile_v], -1),
+        jnp.concatenate([run_i, tile_i], -1),
+        k,
+    )
+
+
+def _select_beams(totals, keys, K: int, V: int):
+    """Per-video next-beam selection from the K·K candidate union.
+    ``totals``/``keys`` (nv, K*K); keys are flat ``k*V + v``.  Returns
+    (scores (nv, K), parent (nv, K), tok (nv, K)) in the exact order
+    ``jax.lax.top_k`` over the scan path's (nv, K*V) array would."""
+    sc, key = _row_topk(totals, keys, K)
+    parent = key // V
+    tok = (key - parent * V).astype(jnp.int32)
+    return sc, parent, tok
+
+
+def _candidate_totals(top_v, top_i, m, ssum, score, fin, K: int, V: int):
+    """Per-row top-K logits -> (totals, flat keys) under the scan path's
+    exact float op order: ``logp = (logit - max) - log(ssum)`` then
+    ``total = score + logp`` (``jax.nn.log_softmax``'s association).
+    Finished rows collapse to ``[(PAD, score + 0.0), (v=1..K-1,
+    score + NEG_INF)]`` — bit-matching the scan path's ``pad_only``
+    row, where the NEG_INF add absorbs the score exactly."""
+    logp = (top_v - m) - jnp.log(ssum)
+    totals = score + logp
+    beam = jax.lax.broadcasted_iota(jnp.int32, top_i.shape, 0) % K
+    keys = beam * V + top_i
+    # Frozen finished beams: PAD continuation at zero cost, then the
+    # lowest vocab ids at NEG_INF (the scan path's tie-order prefix).
+    j = jax.lax.broadcasted_iota(jnp.int32, top_i.shape, 1)
+    fin_tot = jnp.where(j == 0, score + 0.0, score + jnp.float32(NEG_INF))
+    fin_keys = beam * V + jnp.where(j == 0, PAD_ID, j)
+    is_fin = fin > 0.0
+    return (
+        jnp.where(is_fin, fin_tot, totals),
+        jnp.where(is_fin, fin_keys, keys),
+    )
+
+
+def _onehot_parent(parent, K: int):
+    """(nv, K) parent indices -> (nv, K, K) one-hot f32 reduction matrix
+    (exact gather when multiplied against {0,1}/int-valued payloads)."""
+    k_iota = jax.lax.broadcasted_iota(jnp.int32, parent.shape + (K,), 2)
+    return (parent[:, :, None] == k_iota).astype(jnp.float32)
+
+
+# ----------------------------------------------------------------- kernel
+
+def _make_beam_kernel(btv: int, K: int, Kt: int, Vt: int, T: int, V: int,
+                      V_pad: int, static_ctx: bool = False):
+    rt = btv * K
+
+    def kernel(gxs_ref, wx_ref, wh_ref, *rest):
+        if static_ctx:
+            (bout_ref, emb_hbm, wout_hbm, seq_out, sc_out,
+             h_scr, c_scr, fin_scr, score_scr, seq_scr, tokv_scr,
+             toks_smem, emb_scr, wout_scr, sem_emb, sem_w, sem_tok) = rest
+        else:
+            (wctx_ref, awh_ref, av_ref, proj_ref, mask_ref, vals_ref,
+             bout_ref, emb_hbm, wout_hbm, seq_out, sc_out,
+             h_scr, c_scr, fin_scr, score_scr, seq_scr, tokv_scr,
+             toks_smem, emb_scr, wout_scr, sem_emb, sem_w, sem_tok) = rest
+        t = pl.program_id(1)
+        cdt = wh_ref.dtype
+
+        @pl.when(t == 0)
+        def _():
+            h_scr[:] = jnp.zeros_like(h_scr)
+            c_scr[:] = jnp.zeros_like(c_scr)
+            fin_scr[:] = jnp.zeros_like(fin_scr)
+            # Only beam 0 is live at t=0 (all beams start identical).
+            beam = jax.lax.broadcasted_iota(jnp.int32, (rt, 1), 0) % K
+            score_scr[:] = jnp.where(beam == 0, 0.0, jnp.float32(NEG_INF))
+            seq_scr[:] = jnp.full_like(seq_scr, PAD_ID)
+            tokv_scr[:] = jnp.full_like(tokv_scr, BOS_ID)
+            cp = pltpu.make_async_copy(tokv_scr, toks_smem, sem_tok)
+            cp.start()
+            cp.wait()
+
+        # Gather the feed tokens' embedding rows (HBM -> VMEM, one DMA
+        # per row; indices staged in SMEM), issued before the attention
+        # math so the copies hide behind it — the sampler's feed path.
+        def issue(i, _):
+            pltpu.make_async_copy(
+                emb_hbm.at[toks_smem[i, 0]], emb_scr.at[i], sem_emb.at[i]
+            ).start()
+            return 0
+
+        jax.lax.fori_loop(0, rt, issue, 0)
+
+        h = h_scr[:]
+        if not static_ctx:
+            # Attention step (query = previous hidden state).
+            q = jax.lax.dot_general(
+                h.astype(cdt), awh_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            th = jnp.tanh(proj_ref[:] + q.astype(cdt)[:, None, :])
+            vvec = av_ref[:].astype(jnp.float32)[:, 0]
+            s = jnp.sum(
+                th.astype(jnp.float32) * vvec[None, None, :], axis=-1
+            )
+            s = jnp.where(mask_ref[:] > 0, s, NEG_INF)
+            m0 = jnp.max(s, axis=-1, keepdims=True)
+            e = jnp.exp(s - m0)
+            a = e / jnp.sum(e, axis=-1, keepdims=True)
+            ctx = jnp.sum(
+                a[:, :, None] * vals_ref[:].astype(jnp.float32), axis=1
+            )
+
+        def wait(i, _):
+            pltpu.make_async_copy(
+                emb_hbm.at[toks_smem[i, 0]], emb_scr.at[i], sem_emb.at[i]
+            ).wait()
+            return 0
+
+        jax.lax.fori_loop(0, rt, wait, 0)
+
+        # Summation order matters for twin parity (float adds don't
+        # reassociate): gxs + emb [+ ctx] + wh, ctx omitted in the
+        # static variant — the sampler kernel's exact order.
+        gates = gxs_ref[:].astype(jnp.float32) + jax.lax.dot_general(
+            emb_scr[:], wx_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if not static_ctx:
+            gates = gates + jax.lax.dot_general(
+                ctx.astype(cdt), wctx_ref[:],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        gates = gates + jax.lax.dot_general(
+            h.astype(cdt), wh_ref[:],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        h_new, c_new = _gate_update(gates, c_scr[:])
+
+        # Vocab logits streamed in V-tiles; online per-row top-K + LSE.
+        def wcopy(k, slot):
+            return pltpu.make_async_copy(
+                wout_hbm.at[:, pl.ds(k * Vt, Vt)], wout_scr.at[slot],
+                sem_w.at[slot],
+            )
+
+        wcopy(0, 0).start()
+        hq = h_new.astype(cdt)
+        col0 = jax.lax.broadcasted_iota(jnp.int32, (rt, Vt), 1)
+
+        def vloop(k, carry):
+            m, ssum, top_v, top_i = carry
+            slot = jax.lax.rem(k, 2)
+
+            @pl.when(k + 1 < Kt)
+            def _():
+                wcopy(k + 1, jax.lax.rem(k + 1, 2)).start()
+
+            wcopy(k, slot).wait()
+            # Match CaptionModel._logits numerics exactly: the vocab dot
+            # and bias add round through compute dtype BEFORE the f32
+            # cast, so top-K ties break identically to the scan path.
+            logit = (
+                jax.lax.dot_general(
+                    hq, wout_scr[slot],
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(cdt)
+                + bout_ref[:, pl.ds(k * Vt, Vt)].astype(cdt)
+            ).astype(jnp.float32)
+            mk = jnp.maximum(m, jnp.max(logit, axis=-1, keepdims=True))
+            ssum = ssum * jnp.exp(m - mk) + jnp.sum(
+                jnp.exp(logit - mk), axis=-1, keepdims=True
+            )
+            tv, ti = _row_topk(logit, col0 + k * Vt, K)
+            top_v, top_i = _merge_topk(top_v, top_i, tv, ti, K)
+            return mk, ssum, top_v, top_i
+
+        init = (
+            jnp.full((rt, 1), NEG_INF, jnp.float32),
+            jnp.zeros((rt, 1), jnp.float32),
+            jnp.full((rt, K), _F32_MIN, jnp.float32),
+            jax.lax.broadcasted_iota(jnp.int32, (rt, K), 1) + V_pad,
+        )
+        m, ssum, top_v, top_i = jax.lax.fori_loop(0, Kt, vloop, init)
+
+        # Per-row candidates -> per-video beam selection.
+        totals, keys = _candidate_totals(
+            top_v, top_i, m, ssum, score_scr[:], fin_scr[:], K, V
+        )
+        nv = btv
+        sc, parent, tok = _select_beams(
+            totals.reshape(nv, K * K), keys.reshape(nv, K * K), K, V
+        )
+
+        # In-kernel beam reorder: one-hot parent reduction over the beam
+        # axis (exact for {0,1} and integer-valued payloads).
+        P = _onehot_parent(parent, K)                      # (nv, K, K)
+        fin3 = fin_scr[:].reshape(nv, 1, K)
+        fin_g = jnp.sum(P * fin3, axis=-1)                 # (nv, K)
+        ended = (tok == EOS_ID) | (tok == PAD_ID)
+        fin_new = jnp.maximum(fin_g, ended.astype(jnp.float32))
+
+        seq3 = seq_scr[:].reshape(nv, K, T).astype(jnp.float32)
+        seq_g = jnp.sum(
+            P[:, :, :, None] * seq3[:, None, :, :], axis=2
+        )                                                  # (nv, K, T)
+        l_iota = jax.lax.broadcasted_iota(jnp.int32, (nv, K, T), 2)
+        seq_new = jnp.where(
+            l_iota == t, tok[:, :, None].astype(jnp.float32), seq_g
+        ).astype(jnp.int32)
+
+        h3 = h_new.reshape(nv, K, -1)
+        c3 = c_new.reshape(nv, K, -1)
+        h_scr[:] = jnp.sum(
+            P[:, :, :, None] * h3[:, None, :, :], axis=2
+        ).reshape(rt, -1)
+        c_scr[:] = jnp.sum(
+            P[:, :, :, None] * c3[:, None, :, :], axis=2
+        ).reshape(rt, -1)
+        seq_scr[:] = seq_new.reshape(rt, T)
+        score_scr[:] = sc.reshape(rt, 1)
+        fin_scr[:] = fin_new.reshape(rt, 1)
+
+        # Finished beams feed EOS so the next-step embedding is defined.
+        feed = jnp.where(tok == PAD_ID, EOS_ID, tok).reshape(rt, 1)
+        tokv_scr[:] = feed
+        cp = pltpu.make_async_copy(tokv_scr, toks_smem, sem_tok)
+        cp.start()
+        cp.wait()
+
+        seq_out[:] = seq_scr[:]
+        sc_out[:] = score_scr[:]
+
+    return kernel
+
+
+# ------------------------------------------------------------ public entry
+
+def _beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
+               beam_size, max_len, suppress_unk):
+    """Shared pallas_call plumbing for both fusion modes.  ``att`` is
+    ``(w_ctx, att_wh, att_v, att_proj, att_mask, att_vals)`` (per-VIDEO
+    tensors) or None for the static-context (meanpool) variant."""
+    static_ctx = att is None
+    K = beam_size
+    B = gx_static.shape[0]
+    H = wh.shape[0]
+    E = w_x.shape[0]
+    if static_ctx:
+        F = A = 0
+    else:
+        F, A = att[3].shape[1], att[3].shape[2]
+    V = emb.shape[0]
+    cdt = wh.dtype
+    T = max_len
+    btv, Vt = _pick_tiles(B, K, F, A, E, H, T, jnp.dtype(cdt).itemsize)
+    rt = btv * K
+    V_pad = -(-V // Vt) * Vt
+    Kt = V_pad // Vt
+
+    # Decode-policy mask + vocab padding folded into the bias (shared
+    # with the sampler): masked/padded positions never enter the top-K
+    # (they lose every NEG_INF tie to lower vocab ids) and add 0 to LSE.
+    bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
+
+    # Flatten the (B, K) beam grid to R = B*K video-major rows, exactly
+    # like the scan path's jnp.repeat expansion of state and cache.
+    rep = lambda x: jnp.repeat(x, K, axis=0)  # noqa: E731
+    gx_r = rep(gx_static)
+
+    grid = (B // btv, T)
+    per_r = lambda *s: pl.BlockSpec(  # noqa: E731  row-resident blocks
+        (rt,) + s, lambda b, t: (b,) + (0,) * len(s),
+        memory_space=pltpu.VMEM,
+    )
+    const2 = lambda r, w: pl.BlockSpec(  # noqa: E731
+        (r, w), lambda b, t: (0, 0), memory_space=pltpu.VMEM
+    )
+    att_specs, att_args = [], []
+    if not static_ctx:
+        w_ctx, att_wh, att_v, att_proj, att_mask, att_vals = att
+        att_specs = [
+            const2(E, 4 * H),                           # w_ctx
+            const2(H, A),                               # att_wh
+            const2(A, 1),                               # att_v
+            per_r(F, A),                                # att_proj
+            per_r(F),                                   # att_mask
+            per_r(F, E),                                # att_vals
+        ]
+        att_args = [
+            w_ctx, att_wh, att_v, rep(att_proj),
+            rep(att_mask.astype(jnp.float32)), rep(att_vals),
+        ]
+    seqs, scores = pl.pallas_call(
+        _make_beam_kernel(btv, K, Kt, Vt, T, V, V_pad,
+                          static_ctx=static_ctx),
+        grid=grid,
+        in_specs=[
+            per_r(4 * H),                               # gx_static
+            const2(E, 4 * H),                           # w_x
+            const2(H, 4 * H),                           # wh
+            *att_specs,
+            const2(1, V_pad),                           # bias
+            pl.BlockSpec(memory_space=pl.ANY),          # emb (HBM)
+            pl.BlockSpec(memory_space=pl.ANY),          # w_out (HBM)
+        ],
+        out_specs=[per_r(T), per_r(1)],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * K, T), jnp.int32),
+            jax.ShapeDtypeStruct((B * K, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((rt, H), jnp.float32),       # h
+            pltpu.VMEM((rt, H), jnp.float32),       # c
+            pltpu.VMEM((rt, 1), jnp.float32),       # finished
+            pltpu.VMEM((rt, 1), jnp.float32),       # beam scores
+            pltpu.VMEM((rt, T), jnp.int32),         # hypothesis buffer
+            pltpu.VMEM((rt, 1), jnp.int32),         # feed tokens (VMEM)
+            pltpu.SMEM((rt, 1), jnp.int32),         # feed tokens (SMEM)
+            pltpu.VMEM((rt, E), cdt),               # gathered emb rows
+            pltpu.VMEM((2, H, Vt), cdt),            # w_out double buffer
+            pltpu.SemaphoreType.DMA((rt,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=_interpret(),
+    )(
+        gx_r, w_x, wh, *att_args,
+        bias[None, :], emb, w_out_p,
+    )
+    return seqs.reshape(B, K, T), scores.reshape(B, K)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("beam_size", "max_len", "suppress_unk")
+)
+def attlstm_beam(
+    gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
+    att_vals, emb, w_out, b_out,
+    *, beam_size: int, max_len: int, suppress_unk: bool = False,
+):
+    """Fused beam search from zero state (attention fusion).
+
+    Shapes: gx_static (B, 4H) f32 = lstm bias + static (category) gate
+    contribution; w_x (E, 4H), wh (H, 4H), w_ctx (E, 4H), att_wh (H, A),
+    att_v (A, 1), att_proj (B, F, A), att_vals (B, F, E) in compute
+    dtype; att_mask (B, F); emb (V, E) compute dtype; w_out (H, V)
+    compute dtype; b_out (V,) f32.  All per-video tensors are PER VIDEO
+    — the K-beam expansion happens inside.
+
+    Returns ``(seqs (B, K, max_len) int32, scores (B, K) float32)`` —
+    the raw (unnormalized, unsorted) beam state the scan path's scan
+    emits; feed both to ``decoding.beam.finalize_beams``.
+    """
+    return _beam_impl(
+        gx_static, w_x, wh,
+        (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
+        emb, w_out, b_out, beam_size, max_len, suppress_unk,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("beam_size", "max_len", "suppress_unk")
+)
+def lstm_beam(
+    gx_static, w_x, wh, emb, w_out, b_out,
+    *, beam_size: int, max_len: int, suppress_unk: bool = False,
+):
+    """Static-context (meanpool-fusion) fused beam search: the per-video
+    context and category gate contributions are already folded into
+    ``gx_static``.  Same return contract as :func:`attlstm_beam`."""
+    return _beam_impl(
+        gx_static, w_x, wh, None, emb, w_out, b_out,
+        beam_size, max_len, suppress_unk,
+    )
+
+
+# ------------------------------------------------------- pure-XLA reference
+
+def lstm_beam_scan(gx_static, w_x, wh, emb, w_out, b_out,
+                   *, beam_size: int, max_len: int,
+                   suppress_unk: bool = False):
+    """Pure-XLA twin of :func:`lstm_beam` (static-context variant)."""
+    return attlstm_beam_scan(
+        gx_static, w_x, wh, None, None, None, None, None, None,
+        emb, w_out, b_out,
+        beam_size=beam_size, max_len=max_len, suppress_unk=suppress_unk,
+    )
+
+
+def attlstm_beam_scan(
+    gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
+    att_vals, emb, w_out, b_out,
+    *, beam_size: int, max_len: int, suppress_unk: bool = False,
+):
+    """Bit-comparable XLA reference of the kernel: same decomposed GEMM
+    order, same V-tile-chunked log-sum-exp accumulation (via the same
+    ``_pick_tiles``), and the SAME ``_row_topk``/``_select_beams``
+    helpers — tokens AND scores match the kernel exactly at any compute
+    dtype.  ``att_proj is None`` selects the static-context variant."""
+    static_ctx = att_proj is None
+    K = beam_size
+    B = gx_static.shape[0]
+    V = emb.shape[0]
+    cdt = wh.dtype
+    E = w_x.shape[0]
+    H = wh.shape[0]
+    if static_ctx:
+        F = A = 0
+    else:
+        F, A = att_proj.shape[1], att_proj.shape[2]
+    T = max_len
+    _, Vt = _pick_tiles(B, K, F, A, E, H, T, jnp.dtype(cdt).itemsize)
+    V_pad = -(-V // Vt) * Vt
+    Kt = V_pad // Vt
+    bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
+
+    rep = lambda x: jnp.repeat(x, K, axis=0)  # noqa: E731
+    gx_r = rep(gx_static)
+    R = B * K
+    if not static_ctx:
+        proj_r = rep(att_proj)
+        mask_r = rep(att_mask.astype(jnp.float32))
+        vals_r = rep(att_vals)
+        vvec = att_v.astype(jnp.float32)[:, 0]
+    cols = jnp.arange(Vt, dtype=jnp.int32)[None, :]
+
+    def step(carry, t):
+        h, c, fin, score, seqs, tok = carry
+        gates = gx_r.astype(jnp.float32) + jax.lax.dot_general(
+            emb[tok].astype(cdt), w_x,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if not static_ctx:
+            q = jax.lax.dot_general(
+                h.astype(cdt), att_wh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            th = jnp.tanh(proj_r + q.astype(cdt)[:, None, :])
+            s = jnp.sum(
+                th.astype(jnp.float32) * vvec[None, None, :], axis=-1
+            )
+            s = jnp.where(mask_r > 0, s, NEG_INF)
+            m0 = jnp.max(s, axis=-1, keepdims=True)
+            e = jnp.exp(s - m0)
+            a = e / jnp.sum(e, axis=-1, keepdims=True)
+            ctx = jnp.sum(
+                a[:, :, None] * vals_r.astype(jnp.float32), axis=1
+            )
+            gates = gates + jax.lax.dot_general(
+                ctx.astype(cdt), w_ctx,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+        gates = gates + jax.lax.dot_general(
+            h.astype(cdt), wh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        h_new, c_new = _gate_update(gates, c)
+
+        # Full logits, then the kernel's tile-chunked online reduction
+        # (same running-max rescale order, same per-tile top-K merge).
+        logits = (
+            jax.lax.dot_general(
+                h_new.astype(cdt), w_out_p,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(cdt)
+            + bias[None, :].astype(cdt)
+        ).astype(jnp.float32)
+        m = jnp.full((R, 1), NEG_INF, jnp.float32)
+        ssum = jnp.zeros((R, 1), jnp.float32)
+        top_v = jnp.full((R, K), _F32_MIN, jnp.float32)
+        top_i = (
+            jax.lax.broadcasted_iota(jnp.int32, (R, K), 1) + V_pad
+        )
+        for k in range(Kt):
+            tile = jax.lax.dynamic_slice_in_dim(logits, k * Vt, Vt, 1)
+            mk = jnp.maximum(m, jnp.max(tile, axis=-1, keepdims=True))
+            ssum = ssum * jnp.exp(m - mk) + jnp.sum(
+                jnp.exp(tile - mk), axis=-1, keepdims=True
+            )
+            m = mk
+            tv, ti = _row_topk(tile, cols + k * Vt, K)
+            top_v, top_i = _merge_topk(top_v, top_i, tv, ti, K)
+
+        totals, keys = _candidate_totals(
+            top_v, top_i, m, ssum, score, fin, K, V
+        )
+        sc, parent, tok_sel = _select_beams(
+            totals.reshape(B, K * K), keys.reshape(B, K * K), K, V
+        )
+
+        batch_ix = jnp.arange(B)[:, None]
+        seqs = seqs[batch_ix, parent]
+        seqs = jax.lax.dynamic_update_index_in_dim(
+            seqs, tok_sel, t, axis=2
+        )
+        fin2 = fin.reshape(B, K)[batch_ix, parent]
+        ended = (tok_sel == EOS_ID) | (tok_sel == PAD_ID)
+        fin_new = jnp.maximum(fin2, ended.astype(jnp.float32))
+        flat_parent = (batch_ix * K + parent).reshape(-1)
+        h_sel = h_new[flat_parent]
+        c_sel = c_new[flat_parent]
+        feed = jnp.where(tok_sel == PAD_ID, EOS_ID, tok_sel).reshape(-1)
+        return (
+            h_sel, c_sel, fin_new.reshape(R, 1), sc.reshape(R, 1),
+            seqs, feed,
+        ), None
+
+    zeros = jnp.zeros((R, H), jnp.float32)
+    beam = jnp.arange(R, dtype=jnp.int32)[:, None] % K
+    score0 = jnp.where(beam == 0, 0.0, jnp.float32(NEG_INF))
+    carry0 = (
+        zeros, zeros, jnp.zeros((R, 1), jnp.float32), score0,
+        jnp.full((B, K, T), PAD_ID, jnp.int32),
+        jnp.full((R,), BOS_ID, jnp.int32),
+    )
+    (_, _, _, score, seqs, _), _ = jax.lax.scan(
+        step, carry0, jnp.arange(T, dtype=jnp.int32)
+    )
+    return seqs, score.reshape(B, K)

@@ -17,6 +17,40 @@ from jax.sharding import Mesh
 
 log = logging.getLogger("cst_captioning_tpu.parallel")
 
+# jax moved shard_map from jax.experimental to the top level (and
+# renamed its replication check check_rep -> check_vma) across the
+# 0.4.x -> 0.5+ series; this container's pinned jax only has the
+# experimental home, newer ones only document the top-level one.  One
+# compat wrapper here so every call site (ring attention, the sharded
+# CST reward callback) works against either — no new dependency, just
+# the import/kwarg dance.
+try:
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # pragma: no cover - depends on pinned jax
+    from jax.experimental.shard_map import (  # type: ignore
+        shard_map as _shard_map_impl,
+    )
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_rep=None):
+    """Version-portable ``shard_map``.  ``check_rep=False`` disables the
+    static replication check under whichever spelling this jax uses
+    (``check_rep`` old / ``check_vma`` new) — needed around
+    ``io_callback`` bodies, whose outputs the checker cannot prove
+    replicated; ``None`` keeps the version's default."""
+    kwargs = {}
+    if check_rep is not None:
+        import inspect
+
+        params = inspect.signature(_shard_map_impl).parameters
+        for name in ("check_rep", "check_vma"):
+            if name in params:
+                kwargs[name] = check_rep
+                break
+    return _shard_map_impl(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs
+    )
+
 
 def make_mesh(
     shape: Dict[str, int], devices: Optional[Sequence] = None

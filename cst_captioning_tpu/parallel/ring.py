@@ -40,19 +40,31 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from cst_captioning_tpu.parallel.mesh import shard_map
+
 NEG_INF = -1e30
 
 
-def _ring_body(q, k0, v0, kmask0, axis: str, scale: float):
-    """shard_map body: local q (B, Sq, H), rotating k/v (B, Sk, H)."""
-    p = jax.lax.axis_size(axis)
+def _vary(x, axis: str):
+    """Mark ``x`` device-varying over ``axis`` on jax versions whose
+    shard_map has varying-axis typing (``jax.lax.pcast``); identity on
+    older pins where no varying types exist to unify.  Version-compat
+    sibling of ``parallel.mesh.shard_map``."""
+    pcast = getattr(jax.lax, "pcast", None)
+    return x if pcast is None else pcast(x, axis, to="varying")
+
+
+def _ring_body(q, k0, v0, kmask0, axis: str, scale: float, p: int):
+    """shard_map body: local q (B, Sq, H), rotating k/v (B, Sk, H).
+    ``p`` is the static ring size (``mesh.shape[axis]`` — passed in
+    rather than read via ``jax.lax.axis_size``, which newer jax only)."""
     B, Sq, H = q.shape
     qf = q.astype(jnp.float32) * scale
 
     # Accumulators marked device-varying over the ring axis so shard_map's
     # varying-axis typing matches across fori_loop iterations (the loop
     # body's outputs are varying; replicated-typed zeros would not unify).
-    vary = lambda x: jax.lax.pcast(x, axis, to="varying")  # noqa: E731
+    vary = lambda x: _vary(x, axis)  # noqa: E731
     m0 = vary(jnp.full((B, Sq), NEG_INF, jnp.float32))
     l0 = vary(jnp.zeros((B, Sq), jnp.float32))
     o0 = vary(jnp.zeros((B, Sq, v0.shape[-1]), jnp.float32))
@@ -114,8 +126,10 @@ def ring_attention(
     scale = 1.0 / (q.shape[-1] ** 0.5)
     spec = P(None, axis, None)
     mspec = P(None, axis)
-    fn = jax.shard_map(
-        functools.partial(_ring_body, axis=axis, scale=scale),
+    fn = shard_map(
+        functools.partial(
+            _ring_body, axis=axis, scale=scale, p=mesh.shape[axis]
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec, mspec),
         out_specs=spec,
@@ -166,7 +180,7 @@ def sharded_context_attention(
     ``batch_axis`` additionally shards B (data parallelism composes with
     the frame sharding instead of being gathered away).
     """
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(_ctx_body, axis=axis),
         mesh=mesh,
         in_specs=(

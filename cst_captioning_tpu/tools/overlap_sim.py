@@ -31,8 +31,33 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
+
+
+def credibility(rec_per_rep_ms, recoverable_ms: float):
+    """Driver-channel credibility reduction over per-rep recovered-stall
+    samples (VERDICT r5 #5: BENCH_r05 recorded ``recovered_frac 1.144 ±
+    0.301`` — >100% recovery — with nothing flagging it).  Returns
+    ``(recovered_ms, frac, frac_raw, noisy)``: the headline fraction is
+    clamped to [0, 1] (outside is a measurement artifact — the K=1
+    baseline moved under load — never a real recovery), and ``noisy``
+    is set when the spread swamps the signal (sd/|mean| > 0.3) or the
+    raw fraction fell outside [0, 1]."""
+    import numpy as np
+
+    pp = np.asarray(rec_per_rep_ms, dtype=float)
+    recovered = float(pp.mean())
+    frac_raw = (
+        recovered / recoverable_ms if recoverable_ms > 0 else 0.0
+    )
+    frac = min(max(frac_raw, 0.0), 1.0)
+    spread_bad = len(pp) >= 2 and float(pp.std(ddof=1)) > 0.3 * max(
+        abs(recovered), 1e-9
+    )
+    noisy = bool(spread_bad or frac_raw > 1.0 or frac_raw < 0.0)
+    return recovered, frac, frac_raw, noisy
 
 
 def simulate(sleep_ms: float = 0.0, chunks: int = 4, steps: int = 5,
@@ -149,17 +174,37 @@ def simulate(sleep_ms: float = 0.0, chunks: int = 4, steps: int = 5,
     # time): each rep measures K=1 then K=N back-to-back so a load shift
     # hits both layouts, and mean±sd across reps is recorded.
     t1s, tks = [], []
-    for r in range(max(1, reps)):
+
+    def one_rep():
+        r = len(t1s)
         t1s.append(sweep(step1, box1, r))
         tks.append(sweep(stepk, boxk, r))
-    t1s_np, tks_np = np.asarray(t1s), np.asarray(tks)
-    t1, tk = float(t1s_np.mean()), float(tks_np.mean())
+
+    for _ in range(max(1, reps)):
+        one_rep()
     # The rollout is scored (B*S rows) and SCB needs no greedy scoring;
     # K=1 serializes the full sleep, K chunks can hide ~ (K-1)/K of it.
     recoverable = sleep_ms * (chunks - 1) / chunks
-    rec_per_rep = (t1s_np - tks_np) * 1e3
-    recovered = float(rec_per_rep.mean())
-    frac = recovered / recoverable if recoverable > 0 else 0.0
+
+    def rec_per_rep():
+        return (np.asarray(t1s) - np.asarray(tks)) * 1e3
+
+    # Auto-escalate reps while the spread swamps the signal (sd/|mean|
+    # > 0.3, the BENCH_r05 failure mode): co-tenant noise averages out,
+    # and if it doesn't, the record says so via ``noisy`` below.
+    max_reps = int(os.environ.get(
+        "CST_OVERLAP_SIM_MAX_REPS", str(max(9, 3 * max(1, reps)))
+    ))
+    while (
+        len(t1s) > 1 and len(t1s) < max_reps
+        and credibility(rec_per_rep(), recoverable)[3]
+    ):
+        one_rep()
+
+    pp = rec_per_rep()
+    t1 = float(np.asarray(t1s).mean())
+    tk = float(np.asarray(tks).mean())
+    recovered, frac, frac_raw, noisy = credibility(pp, recoverable)
     out = {
         "cst_overlap_sim_dispatch_latency_ms": round(lat, 3),
         "cst_overlap_sim_rollout_compute_ms": round(rollout_ms, 2),
@@ -169,14 +214,20 @@ def simulate(sleep_ms: float = 0.0, chunks: int = 4, steps: int = 5,
         "cst_overlap_sim_recovered_ms": round(recovered, 2),
         "cst_overlap_sim_recoverable_ms": round(recoverable, 2),
         "cst_overlap_sim_recovered_frac": round(frac, 3),
-        "cst_overlap_sim_reps": int(max(1, reps)),
+        "cst_overlap_sim_reps": len(t1s),
+        # Credibility marker for the driver channel: true when the
+        # spread still swamps the signal after rep escalation, or the
+        # raw fraction fell outside [0, 1].
+        "cst_overlap_sim_noisy": noisy,
     }
-    if reps > 1:
+    if round(frac_raw, 3) != round(frac, 3):
+        out["cst_overlap_sim_recovered_frac_raw"] = round(frac_raw, 3)
+    if len(t1s) > 1:
         out["cst_overlap_sim_recovered_ms_sd"] = round(
-            float(rec_per_rep.std(ddof=1)), 2
+            float(pp.std(ddof=1)), 2
         )
         out["cst_overlap_sim_recovered_frac_sd"] = round(
-            float(rec_per_rep.std(ddof=1) / recoverable), 3
+            float(pp.std(ddof=1) / recoverable), 3
         ) if recoverable > 0 else 0.0
     return out
 

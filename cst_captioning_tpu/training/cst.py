@@ -122,12 +122,21 @@ def _baseline_from(rewards: np.ndarray, greedy_scores, S: int,
 
 
 def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
-               advantage, temperature, suppress_unk=False):
+               advantage, temperature, suppress_unk=False,
+               logits_sharding=None):
     """PG loss + Adam update: re-run teacher forcing over the SAMPLED
     tokens so the graph from logits to params is differentiable (the
     rollout is decode-only).  Input = [BOS, tok_0..tok_{L-2}].  ``feats``
     holds the B un-tiled videos; ``repeat=S`` tiles the projected cache
-    to the B*S sampled rows (see ``_repeat_cache``)."""
+    to the B*S sampled rows (see ``_repeat_cache``).
+
+    ``logits_sharding`` (mesh runs only): pins the (rows, T, V) logits to
+    rows-over-data × V-over-model before the log_softmax.  Without the
+    pin, the SPMD partitioner is free to flatten the softmax's (rows, T)
+    max/sum reductions onto ALL devices and then cannot broadcast them
+    back against the vocab-sharded logits without an involuntary full
+    rematerialization — the exact cliff the dryrun's tripwire fails on
+    (__graft_entry__._dryrun_multichip_impl)."""
     B = tokens.shape[0]
     bos = jnp.full((B, 1), BOS_ID, jnp.int32)
     inputs = jnp.concatenate([bos, tokens[:, :-1]], axis=1)
@@ -138,6 +147,10 @@ def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
         logits = state.apply_fn(
             params, feats, feat_masks, inputs, category=category, repeat=S
         )
+        if logits_sharding is not None:
+            logits = jax.lax.with_sharding_constraint(
+                logits, logits_sharding
+            )
         # REINFORCE needs log-probs of the distribution that was actually
         # sampled from: same PAD/BOS(/UNK) masking AND the same
         # temperature scaling as the rollout policy.
@@ -234,7 +247,33 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
     def host_score(video_idx, tokens):
         return rewarder.score_ids(video_idx, tokens).astype(np.float32)
 
-    if mesh is not None and mesh.shape.get("data", 1) > 1:
+    pg_logits_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        pg_logits_sharding = NamedSharding(
+            mesh,
+            P(
+                "data",
+                None,
+                "model" if mesh.shape.get("model", 1) > 1 else None,
+            ),
+        )
+
+    if (
+        mesh is not None
+        and mesh.shape.get("data", 1) > 1
+        # The per-shard callback is only CORRECT where shard_map has
+        # first-class callback lowering (the top-level jax.shard_map
+        # era).  Under the older jax.experimental.shard_map the
+        # io_callback silently lowers to a maximal device-0 call over
+        # ONE shard's rows — wrong rewards for every other shard
+        # (pinned by test_cst.py::TestShardedRewardCallback, which
+        # compares sharded vs unsharded scoring) — so those versions
+        # take the plain global callback below instead.
+        and hasattr(jax, "shard_map")
+    ):
         # Sharded reward crossing (VERDICT r2 #3): an unannotated
         # io_callback compiles to a {maximal device=0} sharding, and SPMD
         # replicates-then-repartitions around it every step ("Involuntary
@@ -246,6 +285,8 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
         # scorer on the same rows (host scoring is hot loop #2,
         # SURVEY.md §3).
         from jax.sharding import PartitionSpec as P
+
+        from cst_captioning_tpu.parallel.mesh import shard_map
 
         other_axes = tuple(
             a for a, n in mesh.shape.items() if a != "data" and n > 1
@@ -269,20 +310,46 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
                     tk,
                 )
 
-            return jax.shard_map(
+            # check_rep=False: the callback's outputs are per-shard host
+            # results — nothing for the replication checker to prove.
+            return shard_map(
                 body,
                 mesh=mesh,
                 in_specs=(P(axes), P(axes, None)),
                 out_specs=P(axes),
+                check_rep=False,
             )(video_idx, tokens)
     else:
+        rep_sharding = None
+        if mesh is not None:
+            # Old-shard_map fallback on a mesh: the global callback runs
+            # on device 0 regardless; explicitly REPLICATING its tiny
+            # operands/result makes every crossing a plain broadcast the
+            # partitioner handles without the involuntary-full-remat
+            # cliff the dryrun tripwire fails on (the tensors are B·S
+            # int32 rows — bytes, not activations).
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            rep_sharding = NamedSharding(mesh, P())
+
         def score(video_idx, tokens):
-            return io_callback(
+            if rep_sharding is not None:
+                video_idx = jax.lax.with_sharding_constraint(
+                    video_idx, rep_sharding
+                )
+                tokens = jax.lax.with_sharding_constraint(
+                    tokens, rep_sharding
+                )
+            out = io_callback(
                 host_score,
                 jax.ShapeDtypeStruct((tokens.shape[0],), jnp.float32),
                 video_idx,
                 tokens,
             )
+            if rep_sharding is not None:
+                out = jax.lax.with_sharding_constraint(out, rep_sharding)
+            return out
 
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
@@ -317,6 +384,7 @@ def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
             state, feats, feat_masks, category, S, rollout.tokens,
             rollout.mask, advantage, temperature,
             suppress_unk=model.decode_suppress_unk,
+            logits_sharding=pg_logits_sharding,
         )
         return state, {
             "loss": loss,

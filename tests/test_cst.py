@@ -555,9 +555,19 @@ class TestCSTTraining:
         save_checkpoint(stage1, pre.state)
 
         cfg = cst_cfg(tmp_path, "greedy", start_from=stage1)
-        cfg.train.max_epochs = 8
+        # 16 epochs with a leading-vs-trailing MEAN comparison: the
+        # per-epoch rollout reward on this 12-video toy oscillates with
+        # the PRNG stream (which differs across jax/backend versions —
+        # the 8-epoch single-endpoint form of this test was stream-lucky
+        # and went red on a jax upgrade while real-scale CST kept
+        # climbing, docs/REHEARSAL.md r6), and the r5/r6 rehearsal
+        # lesson applies at smoke scale too: give slow starters budget.
+        cfg.train.max_epochs = 16
         t = Trainer(cfg, train_ds=ds, val_ds=None,
                     workdir=str(tmp_path / "cst_w"))
         hist = t.fit()
-        first, last = hist["0"]["reward"], hist["7"]["reward"]
-        assert last > first, f"reward did not improve: {first} -> {last}"
+        rewards = [hist[str(e)]["reward"] for e in range(16)]
+        head, tail = np.mean(rewards[:3]), np.mean(rewards[-3:])
+        assert tail > head, (
+            f"reward did not improve: {head:.4f} -> {tail:.4f} ({rewards})"
+        )

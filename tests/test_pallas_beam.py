@@ -1,0 +1,389 @@
+"""Fused whole-recurrence beam-search kernel (ops/pallas_beam.py).
+
+Parity strategy, mirroring tests/test_pallas_sampler.py: the kernel and
+its pure-XLA twin ``attlstm_beam_scan`` share the decomposed GEMM order,
+the V-tile-chunked log-sum-exp accumulation and the ``_row_topk`` tie
+helpers, so tokens AND scores must match EXACTLY.  Against the scan path
+(``decoding/beam.py`` driving ``CaptionModel.decode_one``), float32
+tokens must match exactly on the fixed-seed shapes here (the residual
+daylight is <1-ulp float association at top-K tie boundaries —
+docs/PARITY.md), with scores allclose.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID, UNK_ID
+from cst_captioning_tpu.decoding.beam import (
+    beam_search,
+    fused_beam_engaged,
+    make_beam_search_fn,
+)
+from cst_captioning_tpu.models.captioner import CaptionModel
+from cst_captioning_tpu.ops.pallas_beam import (
+    attlstm_beam,
+    attlstm_beam_scan,
+    beam_shapes_ok,
+    lstm_beam,
+    lstm_beam_scan,
+)
+
+
+def make_args(B=4, H=16, A=16, E=16, F=5, V=50, seed=0, logit_scale=0.3):
+    rng = np.random.RandomState(seed)
+    cdt = jnp.float32
+    arr = lambda *s, sc=0.3: jnp.asarray(rng.randn(*s) * sc, cdt)
+    return dict(
+        gx_static=jnp.asarray(rng.randn(B, 4 * H) * 0.1, jnp.float32),
+        w_x=arr(E, 4 * H),
+        wh=arr(H, 4 * H),
+        w_ctx=arr(E, 4 * H),
+        att_wh=arr(H, A),
+        att_v=arr(A, 1),
+        att_proj=arr(B, F, A),
+        att_mask=jnp.asarray((rng.rand(B, F) > 0.2).astype(np.float32)),
+        att_vals=arr(B, F, E),
+        emb=arr(V, E),
+        w_out=arr(H, V, sc=logit_scale),
+        b_out=jnp.asarray(rng.randn(V) * 0.1, jnp.float32),
+    )
+
+
+def run_both(args, **kw):
+    k = attlstm_beam(*args.values(), **kw)
+    r = attlstm_beam_scan(*args.values(), **kw)
+    return k, r
+
+
+def assert_exact(k, r):
+    np.testing.assert_array_equal(np.asarray(k[0]), np.asarray(r[0]))
+    np.testing.assert_array_equal(np.asarray(k[1]), np.asarray(r[1]))
+
+
+class TestKernelVsTwin:
+    @pytest.mark.parametrize("beam_size", [1, 3, 5])
+    def test_exact_parity(self, beam_size):
+        args = make_args()
+        k, r = run_both(args, beam_size=beam_size, max_len=8)
+        assert_exact(k, r)
+        assert k[0].shape == (4, beam_size, 8)
+        assert k[1].shape == (4, beam_size)
+
+    @pytest.mark.parametrize("seed", [1, 2, 3])
+    def test_randomized_shapes(self, seed):
+        rng = np.random.RandomState(100 + seed)
+        B = int(rng.choice([2, 3, 4]))
+        F = int(rng.choice([3, 5, 7]))
+        V = int(rng.choice([24, 50, 130]))
+        K = int(rng.choice([2, 3, 4]))
+        args = make_args(B=B, F=F, V=V, seed=seed)
+        k, r = run_both(args, beam_size=K, max_len=6)
+        assert_exact(k, r)
+
+    def test_multi_tile_vocab_with_padding(self):
+        """V=1100 forces multiple streamed V-tiles plus a padded tail:
+        the online top-K must merge across tiles and padded columns must
+        never be selected."""
+        args = make_args(V=1100)
+        k, r = run_both(args, beam_size=4, max_len=6)
+        assert_exact(k, r)
+        assert np.asarray(k[0]).max() < 1100
+
+    def test_suppress_unk(self):
+        args = make_args(V=24, seed=3)
+        # Rig UNK to dominate; suppression must bar it from every beam.
+        args["b_out"] = args["b_out"].at[UNK_ID].set(50.0)
+        k_on, r_on = run_both(
+            args, beam_size=3, max_len=5, suppress_unk=True
+        )
+        assert_exact(k_on, r_on)
+        assert not np.any(np.asarray(k_on[0]) == UNK_ID)
+        k_off, _ = run_both(
+            args, beam_size=3, max_len=5, suppress_unk=False
+        )
+        assert np.all(np.asarray(k_off[0])[:, 0, 0] == UNK_ID)
+
+    def test_static_ctx_variant(self):
+        a = make_args(seed=31)
+        sa = {
+            k: a[k] for k in ("gx_static", "w_x", "wh", "emb", "w_out",
+                              "b_out")
+        }
+        k = lstm_beam(*sa.values(), beam_size=3, max_len=8)
+        r = lstm_beam_scan(*sa.values(), beam_size=3, max_len=8)
+        assert_exact(k, r)
+
+
+class TestTiesAndSemantics:
+    def test_duplicate_vocab_columns_tie_to_lower_id(self):
+        """Two vocab entries with IDENTICAL logits at every step: the
+        scan path's lax.top_k resolves the exact tie to the lower flat
+        index, and the kernel's merge must do the same."""
+        args = make_args(V=30, seed=7)
+        lo, hi = 10, 20
+        args["w_out"] = args["w_out"].at[:, hi].set(args["w_out"][:, lo])
+        args["b_out"] = args["b_out"].at[hi].set(args["b_out"][lo])
+        # Rig the tied pair to win step 0 so the tie decides the beam.
+        args["b_out"] = (
+            args["b_out"].at[lo].add(30.0).at[hi].add(30.0)
+        )
+        k, r = run_both(args, beam_size=3, max_len=4)
+        assert_exact(k, r)
+        # The winning beam's first token is the LOWER id of the pair.
+        assert np.all(np.asarray(k[0])[:, 0, 0] == lo)
+
+    def test_eos_freeze_emits_pad_and_holds_score(self):
+        """EOS rigged to win at step 0: the best beam finishes
+        immediately, rides along frozen (PAD continuation at zero cost)
+        and its raw score never changes — the scan path's freeze."""
+        args = make_args(V=24, seed=5)
+        args["b_out"] = args["b_out"].at[EOS_ID].set(50.0)
+        k, r = run_both(args, beam_size=3, max_len=6)
+        assert_exact(k, r)
+        toks = np.asarray(k[0])
+        # Some beam per video starts with EOS; everything after is PAD.
+        eos_rows = toks[:, :, 0] == EOS_ID
+        assert eos_rows.any(axis=1).all()
+        assert np.all(toks[eos_rows][:, 1:] == PAD_ID)
+
+    def test_never_emits_pad_or_bos_while_live(self):
+        args = make_args(V=24, seed=9)
+        args["b_out"] = (
+            args["b_out"].at[PAD_ID].set(50.0).at[BOS_ID].set(49.0)
+        )
+        k, r = run_both(args, beam_size=3, max_len=6)
+        assert_exact(k, r)
+        toks = np.asarray(k[0])
+        # PAD appears only AFTER an EOS (the freeze), never as a live
+        # emission, and BOS never appears at all.
+        assert not np.any(toks == BOS_ID)
+        for row in toks.reshape(-1, toks.shape[-1]):
+            pads = np.nonzero(row == PAD_ID)[0]
+            if len(pads):
+                before = row[: pads[0]]
+                assert len(before) and before[-1] == EOS_ID
+
+    def test_scores_are_summed_logprobs(self):
+        """Beam-1 raw score == the greedy trajectory's summed log-probs
+        (cross-checked against the sampler twin's per-token values)."""
+        from cst_captioning_tpu.ops.pallas_sampler import (
+            attlstm_sample_scan,
+        )
+
+        args = make_args(seed=11)
+        k, r = run_both(args, beam_size=1, max_len=6)
+        assert_exact(k, r)
+        seqs, scores = k
+        toks, lps, mask = attlstm_sample_scan(
+            *args.values(), 0, max_len=6, greedy=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(seqs)[:, 0], np.asarray(toks)
+        )
+        np.testing.assert_allclose(
+            np.asarray(scores)[:, 0],
+            np.asarray(lps).sum(-1),
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+class TestCaptionerIntegration:
+    @staticmethod
+    def build(use_beam, fusion="attention", B=4, V=40, F=3,
+              use_category=False, **extra):
+        kw = dict(
+            vocab_size=V, rnn_size=16, embed_size=16, att_hidden_size=16,
+            num_layers=1, fusion=fusion, modalities=("resnet",),
+            feature_dims=(12,), compute_dtype="float32", drop_prob=0.0,
+            use_category=use_category,
+        )
+        kw.update(extra)
+        model = CaptionModel(use_pallas_beam=use_beam, **kw)
+        rng = np.random.RandomState(2)
+        feats = {"resnet": jnp.asarray(rng.randn(B, F, 12), jnp.float32)}
+        masks = {"resnet": jnp.ones((B, F), jnp.float32)}
+        ids = jnp.asarray(
+            rng.randint(4, V, size=(B, 6)), jnp.int32
+        ).at[:, 0].set(BOS_ID)
+        cat = (
+            jnp.asarray(rng.randint(0, 20, (B,)), jnp.int32)
+            if use_category else None
+        )
+        params = CaptionModel(**kw).init(
+            jax.random.PRNGKey(0), feats, masks, ids, category=cat
+        )
+        return model, params, feats, masks, cat
+
+    @pytest.mark.parametrize("fusion", ["attention", "meanpool"])
+    @pytest.mark.parametrize("length_normalize", [True, False])
+    def test_token_exact_vs_scan_path(self, fusion, length_normalize):
+        fused, params, feats, masks, _ = self.build(True, fusion)
+        scan, *_ = self.build(False, fusion)
+        rf = beam_search(
+            fused, params, feats, masks, beam_size=4, max_len=9,
+            length_normalize=length_normalize,
+        )
+        rs = beam_search(
+            scan, params, feats, masks, beam_size=4, max_len=9,
+            length_normalize=length_normalize,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rf.all_tokens), np.asarray(rs.all_tokens)
+        )
+        np.testing.assert_allclose(
+            np.asarray(rf.all_scores), np.asarray(rs.all_scores),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_category_model(self):
+        fused, params, feats, masks, cat = self.build(
+            True, use_category=True
+        )
+        scan, *_ = self.build(False, use_category=True)
+        rf = beam_search(
+            fused, params, feats, masks, category=cat, beam_size=3,
+            max_len=7,
+        )
+        rs = beam_search(
+            scan, params, feats, masks, category=cat, beam_size=3,
+            max_len=7,
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rf.all_tokens), np.asarray(rs.all_tokens)
+        )
+
+    def test_beam1_equals_greedy_sample(self):
+        fused, params, feats, masks, _ = self.build(True)
+        r = beam_search(
+            fused, params, feats, masks, beam_size=1, max_len=6,
+            length_normalize=False,
+        )
+        g = fused.apply(
+            params, feats, masks, max_len=6, greedy=True, method="sample"
+        )
+        np.testing.assert_array_equal(
+            np.asarray(r.tokens), np.asarray(g.tokens)
+        )
+
+    def test_jitted_dispatch(self):
+        """make_beam_search_fn wraps the dispatch in jit — the fused
+        branch must trace cleanly (pallas_call under jit)."""
+        fused, params, feats, masks, _ = self.build(True)
+        fn = make_beam_search_fn(fused, beam_size=3, max_len=6)
+        r = fn(params, feats, masks)
+        assert r.tokens.shape == (4, 6)
+        assert r.all_tokens.shape == (4, 3, 6)
+        s = np.asarray(r.all_scores)
+        assert (np.diff(s, axis=1) <= 1e-6).all()
+
+
+class TestGateAndFallback:
+    def test_beam_shapes_ok_vocab_floor(self):
+        # The union argument needs >= K live candidates: V < K + 4 fails.
+        assert not beam_shapes_ok(8, 5, 8, 16, 16, 16, 3, 4)
+        assert beam_shapes_ok(8, 5, 50, 16, 16, 16, 3, 4)
+        assert not beam_shapes_ok(8, 0, 50, 16, 16, 16, 3, 4)
+
+    def test_gate_falls_back_to_scan(self):
+        """Vocab too small for the fused path: beam_search must decline
+        (with a log line) and still produce correct output."""
+        m, params, feats, masks, _ = TestCaptionerIntegration.build(
+            True, V=8
+        )
+        scan, *_ = TestCaptionerIntegration.build(False, V=8)
+        engaged, reason = fused_beam_engaged(m, feats, 5)
+        assert not engaged and "shape gate" in reason
+        rf = beam_search(m, params, feats, masks, beam_size=5, max_len=5)
+        rs = beam_search(
+            scan, params, feats, masks, beam_size=5, max_len=5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(rf.all_tokens), np.asarray(rs.all_tokens)
+        )
+
+    def test_two_layer_model_declines(self):
+        m, params, feats, masks, _ = TestCaptionerIntegration.build(
+            True, num_layers=2
+        )
+        engaged, reason = fused_beam_engaged(m, feats, 3)
+        assert not engaged and "num_layers" in reason
+        r = beam_search(m, params, feats, masks, beam_size=3, max_len=5)
+        assert r.tokens.shape == (4, 5)
+
+
+class TestDeclineWarnings:
+    """VERDICT r5 #4: a requested-but-gated-off fused path must say so."""
+
+    def test_beam_search_warns_on_shape_decline(self, caplog):
+        m, params, feats, masks, _ = TestCaptionerIntegration.build(
+            True, V=8
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="cst_captioning_tpu.models"
+        ):
+            beam_search(m, params, feats, masks, beam_size=5, max_len=4)
+        assert any(
+            "use_pallas_beam" in r.message and "gated off" in r.message
+            for r in caplog.records
+        )
+
+    def test_model_from_config_warns_on_backend_gate(self, caplog):
+        """On the CPU test backend, the MSR-VTT preset's requested
+        sampler AND beam kernels are gated off — both must log why."""
+        from cst_captioning_tpu.config import get_preset
+        from cst_captioning_tpu.models import model_from_config
+
+        cfg = get_preset("msrvtt_resnet_c3d_xe")
+        cfg.model.vocab_size = 64
+        with caplog.at_level(
+            logging.WARNING, logger="cst_captioning_tpu.models"
+        ):
+            model = model_from_config(cfg)
+        msgs = [r.message for r in caplog.records]
+        assert any(
+            "use_pallas_sampler" in m and "not tpu" in m for m in msgs
+        )
+        assert any(
+            "use_pallas_beam" in m and "not tpu" in m for m in msgs
+        )
+        assert not model.use_pallas_sampler and not model.use_pallas_beam
+
+    def test_model_from_config_warns_on_two_layers(self, caplog,
+                                                   monkeypatch):
+        from cst_captioning_tpu.config import get_preset
+        from cst_captioning_tpu.models import captioner, model_from_config
+
+        cfg = get_preset("msrvtt_resnet_c3d_xe")
+        cfg.model.vocab_size = 64
+        cfg.model.num_layers = 2
+        monkeypatch.setattr(
+            captioner.jax, "default_backend", lambda: "tpu"
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="cst_captioning_tpu.models"
+        ):
+            model = model_from_config(cfg)
+        assert any(
+            "num_layers=2" in r.message for r in caplog.records
+        )
+        assert not model.use_pallas_beam
+
+    def test_sampler_shape_decline_warns(self, caplog):
+        """Directly-constructed model (bypasses model_from_config): the
+        in-model shape gate must log when it declines."""
+        m, params, feats, masks, _ = TestCaptionerIntegration.build(
+            False, B=3, use_pallas_sampler=True
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="cst_captioning_tpu.models"
+        ):
+            m.apply(params, feats, masks, max_len=4, method="sample")
+        assert any(
+            "use_pallas_sampler" in r.message and "shape gate"
+            in r.message
+            for r in caplog.records
+        )
