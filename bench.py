@@ -27,7 +27,9 @@ Env knobs: BENCH_FIRST_CHUNK (steps in the cheap first XE dispatch),
 BENCH_CHUNK (steps per dispatch), BENCH_ITERS, BENCH_PALLAS,
 BENCH_CST=0 to skip the CST section, BENCH_ATTN=0 to skip the
 attention-fusion XE bench (it compiles a second model), BENCH_DECODE=0
-to skip greedy/beam decode throughput, BENCH_LOADER=0 to skip the
+to skip greedy/beam decode throughput, BENCH_SERVING=0 to skip the
+online-serving offered-load sweep (BENCH_SERVING_REQS /
+BENCH_SERVING_CLIENTS size it), BENCH_LOADER=0 to skip the
 packed-loader assembly bench, BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -488,6 +490,133 @@ def bench_decode():
     return out
 
 
+def bench_serving():
+    """Serving subsystem offered-load sweep (serving/): N concurrent
+    closed-loop clients through the micro-batcher + warm engine ->
+    captions/s and p50/p99 end-to-end latency, plus the queue/device
+    latency split and the cache hit rate from /metrics' counters.
+
+    On TPU the engine runs the MSR-VTT shape (driver config 5: beam-5,
+    resnet+c3d); on CPU hosts it drops to the synthetic-smoke shape so
+    the sweep stays seconds, and records which shape ran.  Random
+    weights — serving throughput is caption-content-independent.
+    Env: BENCH_SERVING_REQS (requests per client per point, default 6),
+    BENCH_SERVING_CLIENTS (sweep points, default "2,8,16")."""
+    import threading
+
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.serving.batcher import MicroBatcher
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+    on_tpu = jax.default_backend() == "tpu"
+    if on_tpu:
+        cfg = _msrvtt_cfg()
+        cfg.eval.beam_size = 5
+        vocab = Vocabulary(
+            [f"w{i}" for i in range(cfg.model.vocab_size - 4)]
+        )
+        cfg.serving.max_batch_size = cfg.data.batch_size
+        cfg.serving.batch_shapes = [8, 16, 32, 64]
+        shape = "msrvtt"
+    else:
+        cfg = get_preset("synthetic_smoke")
+        vocab = None
+        shape = "smoke"
+    cfg.serving.max_wait_ms = 5.0
+    cfg.serving.queue_depth = 2048  # sweep measures latency, not rejects
+    cfg.serving.warmup = True
+    engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+
+    # Unique-feature pool + 25% repeats so tier-1 sees realistic reuse.
+    rng = np.random.RandomState(17)
+    F = cfg.data.max_frames
+    pool = [
+        {
+            "features": {
+                m: rng.randn(F, d).astype(np.float32)
+                for m, d in cfg.data.feature_dims.items()
+            }
+        }
+        for _ in range(32)
+    ]
+
+    reqs_per_client = int(os.environ.get("BENCH_SERVING_REQS", "6"))
+    clients = [
+        int(c)
+        for c in os.environ.get("BENCH_SERVING_CLIENTS", "2,8,16").split(",")
+    ]
+    out = {"serving_shape": shape}
+    sweep = {}
+    for n_clients in clients:
+        metrics = ServingMetrics()
+        batcher = MicroBatcher(engine, metrics)
+        lat_ms, errors = [], []
+        lock = threading.Lock()
+
+        def client(cid, batcher=batcher, lat_ms=lat_ms, errors=errors):
+            r = np.random.RandomState(1000 + cid)
+            for i in range(reqs_per_client):
+                # ~25% of traffic re-requests a recently-seen payload.
+                k = r.randint(8) if r.rand() < 0.25 else r.randint(len(pool))
+                t0 = time.perf_counter()
+                try:
+                    batcher.submit(pool[k], deadline_ms=120_000.0)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        with batcher:
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        cache = engine.cache.stats()
+        sweep[f"clients{n_clients}"] = {
+            "captions_per_sec": round(len(lat_ms) / wall, 2),
+            "p50_ms": round(np.percentile(lat_ms, 50), 2) if lat_ms else None,
+            "p99_ms": round(np.percentile(lat_ms, 99), 2) if lat_ms else None,
+            "queue_p50_ms": round(
+                metrics.stages["queue"].percentile(50), 2
+            ),
+            "device_p50_ms": round(
+                metrics.stages["device"].percentile(50), 2
+            ),
+            "mean_batch": round(metrics.mean_batch_size(), 2),
+            "served": metrics.requests_served.value,
+            "errors": len(errors),
+        }
+        if n_clients == 8:
+            out.update({
+                "serving_captions_per_sec": sweep["clients8"][
+                    "captions_per_sec"
+                ],
+                "serving_p50_ms": sweep["clients8"]["p50_ms"],
+                "serving_p99_ms": sweep["clients8"]["p99_ms"],
+                "serving_queue_p50_ms": round(
+                    metrics.stages["queue"].percentile(50), 2
+                ),
+                "serving_device_p50_ms": round(
+                    metrics.stages["device"].percentile(50), 2
+                ),
+                "serving_mean_batch": round(metrics.mean_batch_size(), 2),
+                "serving_cache_hit_rate": cache["captions"]["hit_rate"],
+                "serving_dropped_live": metrics.requests_failed.value,
+            })
+    out["serving_sweep"] = sweep
+    return out
+
+
 def bench_loader():
     """Host batch assembly from the packed feature store at MSR-VTT shape
     (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
@@ -777,6 +906,15 @@ def main() -> int:
             extra.update(bench_decode())
         except Exception as e:
             extra["decode_error"] = f"{type(e).__name__}: {e}"
+        emit()
+    if os.environ.get("BENCH_SERVING", "1") == "1":
+        # Serving subsystem sweep (serving/): needs a live jax backend
+        # but drops to the CPU-sized shape off-TPU, so it runs in
+        # degraded mode too as long as ANY backend initializes.
+        try:
+            extra.update(bench_serving())
+        except Exception as e:
+            extra["serving_error"] = f"{type(e).__name__}: {e}"
         emit()
     if os.environ.get("BENCH_LOADER", "1") == "1":
         # Host-only bench: runs even when the device backend is down.

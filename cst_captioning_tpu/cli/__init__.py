@@ -4,6 +4,9 @@
   python -m cst_captioning_tpu.cli.train --preset msvd_resnet_xe [...]
   python -m cst_captioning_tpu.cli.test  --preset msrvtt_eval_beam5 \\
       --checkpoint path/to/ckpt [...]
+  python -m cst_captioning_tpu.cli.serve --preset msrvtt_serve_beam5 \\
+      --checkpoint path/to/ckpt [...]   # online HTTP serving (no
+                                        # reference equivalent)
 
 Flags are the ``--section.field`` bridge in ``config.py`` (flag-for-flag
 parity with ``opts.py``), plus ``--preset`` / ``--config`` layering which

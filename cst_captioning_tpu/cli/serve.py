@@ -1,0 +1,60 @@
+"""Online caption-serving CLI (the subsystem entry point):
+
+  python -m cst_captioning_tpu.cli.serve --preset msrvtt_serve_beam5 \\
+      --checkpoint checkpoints/msrvtt_cst_ms_scb/best \\
+      [--serving.port 8000] [--serving.max_wait_ms 8] \\
+      [--serving.decode_mode beam]
+
+Loads the checkpoint once, pre-jits the batch-shape ladder, and serves
+``POST /v1/caption`` (plus ``/healthz``, ``/metrics``, ``/stats``)
+through the micro-batching scheduler — see docs/SERVING.md.
+
+``--random-init`` serves freshly-initialized weights instead of a
+checkpoint (load testing / smoke runs only — the captions are noise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+from cst_captioning_tpu.config import parse_cli
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--checkpoint", default="")
+    parser.add_argument(
+        "--random-init", action="store_true",
+        help="serve random weights (load testing only)",
+    )
+    known, rest = parser.parse_known_args(argv)
+    cfg = parse_cli(rest)
+    if not known.checkpoint and not known.random_init:
+        print(
+            "serve: need --checkpoint PATH (or --random-init for a "
+            "weights-free load-test server)",
+            file=sys.stderr,
+        )
+        return 2
+
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.server import CaptionServer
+
+    engine = InferenceEngine(
+        cfg,
+        checkpoint=known.checkpoint,
+        random_init=known.random_init,
+    )
+    server = CaptionServer(engine)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

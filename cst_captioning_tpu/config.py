@@ -190,11 +190,39 @@ class EvalConfig:
 
 
 @dataclass
+class ServingConfig:
+    """Online caption-serving subsystem (``cst_captioning_tpu/serving/``):
+    warm-engine shape ladder, micro-batching scheduler, caches, HTTP
+    front end.  No reference equivalent — the reference is batch-only."""
+
+    host: str = "127.0.0.1"
+    port: int = 8000              # 0 = ephemeral (tests)
+    # Decode backend for served requests: "beam" matches the offline
+    # eval path token-exactly (the serving parity contract); "greedy"
+    # is the cheaper validation-style decode.
+    decode_mode: str = "beam"
+    # Fixed batch shapes the engine pre-jits (ascending).  Empty = a
+    # power-of-two ladder 1, 2, 4, ... up to max_batch_size.  Every
+    # served batch is padded up to the smallest ladder shape that fits,
+    # so the jit cache never grows past the ladder.
+    batch_shapes: List[int] = field(default_factory=list)
+    max_batch_size: int = 8       # coalescing target (ladder top)
+    max_wait_ms: float = 5.0      # micro-batch coalescing window
+    queue_depth: int = 256        # bounded request queue (backpressure)
+    default_deadline_ms: float = 10_000.0  # per-request deadline
+    retry_after_s: float = 0.25   # hint returned on queue-full rejects
+    caption_cache_size: int = 4096   # tier-1: content hash -> caption
+    feature_cache_size: int = 512    # tier-2: feature id -> encoder state
+    warmup: bool = True           # pre-jit the whole ladder at startup
+
+
+@dataclass
 class Config:
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     train: TrainConfig = field(default_factory=TrainConfig)
     eval: EvalConfig = field(default_factory=EvalConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
     name: str = "default"
 
     # ------------------------------------------------------------------ io
@@ -221,6 +249,7 @@ class Config:
             model=build(ModelConfig, d.get("model", {})),
             train=build(TrainConfig, d.get("train", {})),
             eval=build(EvalConfig, d.get("eval", {})),
+            serving=build(ServingConfig, d.get("serving", {})),
             name=d.get("name", "default"),
         )
 
@@ -320,6 +349,22 @@ def _preset_msrvtt_eval() -> Config:
     return c
 
 
+def _preset_msrvtt_serve() -> Config:
+    """Online serving: MSR-VTT checkpoint behind the micro-batching HTTP
+    front end (cli/serve.py), beam-5 decode for offline parity.  The
+    64-wide ladder top matches the training batch so the fused beam
+    kernel's shape gate sees the shapes it was calibrated for."""
+    c = _preset_msrvtt_eval()
+    c.name = "msrvtt_serve_beam5"
+    c.serving.max_batch_size = 64
+    c.serving.batch_shapes = [8, 16, 32, 64]
+    c.serving.max_wait_ms = 8.0
+    c.serving.queue_depth = 1024
+    c.serving.caption_cache_size = 65536
+    c.serving.feature_cache_size = 4096
+    return c
+
+
 def _preset_synthetic_smoke() -> Config:
     """CPU-runnable synthetic tiny config (tests / CI / integration)."""
     c = Config(name="synthetic_smoke")
@@ -339,6 +384,12 @@ def _preset_synthetic_smoke() -> Config:
     c.train.log_every = 5
     c.eval.beam_size = 3
     c.eval.max_decode_len = 12
+    c.serving.max_batch_size = 8
+    c.serving.batch_shapes = [2, 4, 8]
+    c.serving.max_wait_ms = 20.0
+    c.serving.queue_depth = 32
+    c.serving.caption_cache_size = 64
+    c.serving.feature_cache_size = 16
     return c
 
 
@@ -348,6 +399,7 @@ PRESETS = {
     "msrvtt_wxe_cst_gt_none": _preset_msrvtt_wxe_cst_gt,
     "msrvtt_cst_ms_scb": _preset_msrvtt_cst_ms,
     "msrvtt_eval_beam5": _preset_msrvtt_eval,
+    "msrvtt_serve_beam5": _preset_msrvtt_serve,
     "synthetic_smoke": _preset_synthetic_smoke,
 }
 
@@ -385,7 +437,8 @@ def parse_cli(argv: Optional[Sequence[str]] = None) -> Config:
     parser.add_argument("--preset", type=str, default=None)
     parser.add_argument("--config", type=str, default=None, help="JSON config file")
     for section, tp in (("data", DataConfig), ("model", ModelConfig),
-                        ("train", TrainConfig), ("eval", EvalConfig)):
+                        ("train", TrainConfig), ("eval", EvalConfig),
+                        ("serving", ServingConfig)):
         _add_section(parser, section, tp)
     args = parser.parse_args(argv)
 

@@ -138,6 +138,28 @@ def beam_search(
     state, cache = model.apply(
         params, feats, feat_masks, category, method="init_decode"
     )
+    return beam_search_from_state(
+        model, params, state, cache,
+        beam_size=K, max_len=max_len, length_normalize=length_normalize,
+    )
+
+
+def beam_search_from_state(
+    model: CaptionModel,
+    params,
+    state,
+    cache,
+    *,
+    beam_size: int = 5,
+    max_len: int = 30,
+    length_normalize: bool = True,
+) -> BeamResult:
+    """Scan-path beam search from a pre-encoded ``(state, cache)`` pair
+    (``CaptionModel.init_decode``).  This IS the tail of
+    :func:`beam_search` — the serving engine calls it directly so a
+    feature-cache hit (serving/cache.py tier 2) skips the encoder
+    projections while producing the identical token stream."""
+    K = beam_size
     B = state.h.shape[1]
     V = model.vocab_size
 

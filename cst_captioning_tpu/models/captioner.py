@@ -859,9 +859,12 @@ class CaptionModel(nn.Module):
         E = self.embed_size
         C = cache.cat_emb.shape[-1]
         gx_static = self._fused_gx_static(cache)
-        # Any PRNG impl's key -> one int32 seed word (the kernel's hash
-        # stream fans it out per row/step/position).
-        seed = jax.random.bits(rng, (), jnp.uint32).astype(jnp.int32)
+        # Any PRNG impl's key -> TWO int32 seed words (the kernel's hash
+        # stream fans them out per row/step/position).  Both words enter
+        # the stream, so the effective seed space is 64-bit — a single
+        # collapsed word had ~1e-3 birthday-collision odds of replaying
+        # a step's Gumbel noise over a ~100k-step CST run (ADVICE r5 #2).
+        seed = jax.random.bits(rng, (2,), jnp.uint32).astype(jnp.int32)
         common = dict(
             max_len=max_len,
             greedy=greedy,

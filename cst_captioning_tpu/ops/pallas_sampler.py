@@ -181,9 +181,8 @@ def _masked_vocab(b_out, w_out, V: int, V_pad: int, suppress_unk: bool,
 # ----------------------------------------------------------------- kernel
 
 def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
-                        greedy: bool, inv_temp: float,
-                        static_ctx: bool = False):
-    def kernel(seed_ref, gxs_ref, wx_ref, wh_ref, *rest):
+                        greedy: bool, static_ctx: bool = False):
+    def kernel(seed_ref, it_ref, gxs_ref, wx_ref, wh_ref, *rest):
         if static_ctx:
             # Meanpool fusion: the (static) context's gate contribution
             # is folded into gx_static outside — no attention refs.
@@ -281,9 +280,16 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
 
         wcopy(0, 0).start()
         hq = h_new.astype(cdt)
+        inv_temp = it_ref[0]
+        # Both 32-bit key words enter the stream (ADVICE r5 #2): word 0
+        # is tile-mixed as before, word 1 chains through a second
+        # finalizer round, widening the effective seed space to 64 bits.
         seed_word = _fmix32(
-            seed_ref[0].astype(jnp.uint32)
-            + jnp.uint32(0x9E3779B9) * (b * bt).astype(jnp.uint32)
+            _fmix32(
+                seed_ref[0].astype(jnp.uint32)
+                + jnp.uint32(0x9E3779B9) * (b * bt).astype(jnp.uint32)
+            )
+            + seed_ref[1].astype(jnp.uint32)
         )
         col0 = jax.lax.broadcasted_iota(jnp.int32, (bt, Vt), 1)
         row = jax.lax.broadcasted_iota(jnp.int32, (bt, Vt), 0)
@@ -309,7 +315,7 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
                 ).astype(cdt)
                 + bout_ref[:, pl.ds(k * Vt, Vt)].astype(cdt)
             ).astype(jnp.float32)
-            scaled = logit * jnp.float32(inv_temp)
+            scaled = logit * inv_temp
             if greedy:
                 z = scaled
             else:
@@ -396,6 +402,25 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
     # module doc): masked/padded positions never win and add 0 to LSE.
     bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
 
+    # Two 32-bit seed words (ADVICE r5 #2); a legacy scalar seed pads
+    # word 1 with zero.  Kept traced — no recompile per seed.
+    seed2 = jnp.asarray(seed, jnp.int32).reshape(-1)
+    if seed2.shape[0] < 2:
+        seed2 = jnp.concatenate(
+            [seed2, jnp.zeros((2 - seed2.shape[0],), jnp.int32)]
+        )
+    else:
+        seed2 = seed2[:2]
+    # Temperature reaches the kernel as an SMEM scalar (ADVICE r5 #1):
+    # distinct (or traced) temperatures reuse one compiled kernel, like
+    # the scan path.  The scan path ignores temperature in greedy mode
+    # (logp = log_softmax of the RAW logits); match it so the returned
+    # logprobs agree regardless of which backend the shape gate picks.
+    inv_temp = (
+        jnp.float32(1.0) if greedy
+        else jnp.float32(1.0) / jnp.asarray(temperature, jnp.float32)
+    )
+
     T = max_len
     grid = (B // bt, T)
     tm = lambda: pl.BlockSpec(  # noqa: E731  time-major outputs
@@ -425,17 +450,12 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
         ]
     toks, lps, msk = pl.pallas_call(
         _make_sample_kernel(
-            bt, Vt, K, T, V_pad, bool(greedy),
-            # The scan path ignores temperature in greedy mode (logp =
-            # log_softmax of the RAW logits); match it so the returned
-            # logprobs agree regardless of which backend the shape gate
-            # picks.
-            1.0 if greedy else 1.0 / float(temperature),
-            static_ctx=static_ctx,
+            bt, Vt, K, T, V_pad, bool(greedy), static_ctx=static_ctx,
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),      # seed
+            pl.BlockSpec(memory_space=pltpu.SMEM),      # seed words (2,)
+            pl.BlockSpec(memory_space=pltpu.SMEM),      # inv_temp (1,)
             pl.BlockSpec((bt, 4 * H), lambda b, t: (b, 0),
                          memory_space=pltpu.VMEM),      # gx_static
             const2(E, 4 * H),                           # w_x
@@ -465,7 +485,7 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
         ],
         interpret=_interpret(),
     )(
-        jnp.asarray(seed, jnp.int32).reshape((1,)),
+        seed2, inv_temp.reshape((1,)),
         gx_static, w_x, wh, *att_args,
         bias[None, :], emb, w_out_p,
     )
@@ -478,9 +498,7 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
 
 @functools.partial(
     jax.jit,
-    static_argnames=(
-        "max_len", "greedy", "temperature", "suppress_unk"
-    ),
+    static_argnames=("max_len", "greedy", "suppress_unk"),
 )
 def attlstm_sample(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
@@ -494,7 +512,10 @@ def attlstm_sample(
     contribution; w_x (E, 4H), wh (H, 4H), w_ctx (E, 4H), att_wh (H, A),
     att_v (A, 1), att_proj (B, F, A), att_vals (B, F, E) in compute
     dtype; att_mask (B, F); emb (V, E) compute dtype; w_out (H, V)
-    compute dtype; b_out (V,) f32; seed () or (1,) int32.
+    compute dtype; b_out (V,) f32; seed () / (1,) / (2,) int32 — two
+    32-bit hash-stream key words (a scalar pads word 1 with zero).
+    ``temperature`` may be a traced array: it reaches the kernel as an
+    SMEM scalar, so distinct temperatures share one compiled kernel.
 
     Returns (tokens, logprobs, mask), each (B, max_len), with the exact
     finished-row semantics of ``CaptionModel._sample_from_cache``.
@@ -509,9 +530,7 @@ def attlstm_sample(
 
 @functools.partial(
     jax.jit,
-    static_argnames=(
-        "max_len", "greedy", "temperature", "suppress_unk"
-    ),
+    static_argnames=("max_len", "greedy", "suppress_unk"),
 )
 def lstm_sample(
     gx_static, w_x, wh, emb, w_out, b_out, seed,
@@ -574,18 +593,30 @@ def attlstm_sample_scan(
     V_pad = -(-V // Vt) * Vt
     bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
 
-    seed_arr = jnp.asarray(seed, jnp.int32).reshape(())
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(-1)
+    if seed_arr.shape[0] < 2:
+        seed_arr = jnp.concatenate(
+            [seed_arr, jnp.zeros((2 - seed_arr.shape[0],), jnp.int32)]
+        )
     rows = jnp.arange(B, dtype=jnp.int32)
     # Rows within a tile share the seed word; the counter separates them.
+    # Both key words enter the stream, mirroring the kernel exactly.
     seed_words = _fmix32(
-        seed_arr.astype(jnp.uint32)
-        + jnp.uint32(0x9E3779B9) * ((rows // bt) * bt).astype(jnp.uint32)
+        _fmix32(
+            seed_arr[0].astype(jnp.uint32)
+            + jnp.uint32(0x9E3779B9)
+            * ((rows // bt) * bt).astype(jnp.uint32)
+        )
+        + seed_arr[1].astype(jnp.uint32)
     )  # (B,)
     static_ctx = att_proj is None
     if not static_ctx:
         maskf = att_mask.astype(jnp.float32)
         vvec = att_v.astype(jnp.float32)[:, 0]
-    inv_temp = jnp.float32(1.0 if greedy else 1.0 / float(temperature))
+    inv_temp = (
+        jnp.float32(1.0) if greedy
+        else jnp.float32(1.0) / jnp.asarray(temperature, jnp.float32)
+    )
     cols = jnp.arange(V_pad, dtype=jnp.int32)
 
     def step2(carry, t):

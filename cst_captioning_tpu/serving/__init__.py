@@ -1,0 +1,41 @@
+"""Online caption-serving subsystem.
+
+The repo was batch-only (``cli/test.py`` / ``evaluation.py`` decode a
+fixed dataset and exit); this package adds the request path the ROADMAP
+north star ("serves heavy traffic") needs, built around the same padded
+fixed-shape discipline as training:
+
+* ``engine``  — warm-model inference engine: loads an orbax checkpoint
+  once, pre-jits greedy/beam decode at a ladder of fixed batch shapes,
+  and exposes a synchronous ``decode_batch``.  A served caption is
+  token-exact with the offline ``evaluation.py`` beam path for the same
+  checkpoint/features (the serving parity contract, pinned in
+  ``tests/test_serving.py``).
+* ``batcher`` — micro-batching scheduler: bounded queue, batch-size /
+  ``max_wait_ms`` coalescing, shape-bucket padding, per-request
+  deadlines + cancellation, reject-with-retry-after backpressure.
+* ``cache``   — two-tier LRU: content-hash -> decoded caption, and
+  feature-id -> projected encoder state (skips the encode GEMMs on the
+  scan beam path via ``decoding.beam.beam_search_from_state``).
+* ``server``  — stdlib-only HTTP front end (``/v1/caption``,
+  ``/healthz``, ``/metrics``, ``/stats``); entry point
+  ``python -m cst_captioning_tpu.cli.serve``.
+* ``metrics`` — per-stage latency histograms (queue / pad / device /
+  detokenize) + counters surfaced on ``/metrics``.
+
+Architecture notes and the capacity/latency model live in
+``docs/SERVING.md``.
+"""
+
+from cst_captioning_tpu.serving.batcher import (  # noqa: F401
+    BackpressureError,
+    DeadlineExceededError,
+    MicroBatcher,
+)
+from cst_captioning_tpu.serving.cache import LRUCache, TwoTierCache  # noqa: F401
+from cst_captioning_tpu.serving.engine import InferenceEngine  # noqa: F401
+from cst_captioning_tpu.serving.metrics import (  # noqa: F401
+    LatencyHistogram,
+    ServingMetrics,
+)
+from cst_captioning_tpu.serving.server import CaptionServer  # noqa: F401

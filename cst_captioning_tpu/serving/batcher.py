@@ -1,0 +1,281 @@
+"""Async micro-batching scheduler over the inference engine.
+
+Requests from any number of front-end threads enter a BOUNDED queue; a
+single scheduler thread coalesces them into fixed-shape batches for
+``InferenceEngine.decode_prepared``:
+
+* **Coalescing**: the scheduler sleeps until a request arrives, then
+  waits at most ``max_wait_ms`` past the FIRST queued request's arrival
+  for the batch to fill to ``max_batch_size`` — the classic
+  latency/utilization dial.  A full batch dispatches immediately.
+* **Shape buckets**: a drained batch of n requests pads up to the
+  engine's smallest ladder shape >= n, so the device only ever sees
+  pre-compiled shapes (engine.py owns the padding).
+* **Deadlines + cancellation**: every request carries an absolute
+  deadline (``default_deadline_ms`` unless the client set one).  A
+  request that expires while queued is dropped BEFORE it wastes device
+  work; its submitter gets :class:`DeadlineExceededError`.
+* **Backpressure**: when the queue is full, ``submit`` fails fast with
+  :class:`BackpressureError` carrying a retry-after hint — the HTTP
+  layer maps it to 429 + ``Retry-After``.  Nothing non-expired that was
+  ACCEPTED is ever dropped (the zero-drop contract in the tier-1 load
+  test).
+
+Tier-1 cache hits short-circuit in ``submit`` — an identical request
+returns without touching the queue or the device.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Any, Deque, Dict, List, Optional
+
+from cst_captioning_tpu.serving.engine import InferenceEngine
+from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+
+class BackpressureError(Exception):
+    """Bounded queue is full — retry after ``retry_after_s``."""
+
+    def __init__(self, retry_after_s: float):
+        super().__init__(
+            f"request queue full; retry after {retry_after_s:.2f}s"
+        )
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(Exception):
+    """The request's deadline passed before a result was produced."""
+
+
+class _Pending:
+    __slots__ = ("prepared", "future", "t_enqueue", "deadline")
+
+    def __init__(self, prepared, deadline: float):
+        self.prepared = prepared
+        self.future: "Future[Dict[str, Any]]" = Future()
+        self.t_enqueue = time.monotonic()
+        self.deadline = deadline
+
+
+class MicroBatcher:
+    """See module doc.  One instance per engine; start() spawns the
+    scheduler thread, stop() drains it."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        metrics: Optional[ServingMetrics] = None,
+        *,
+        max_batch_size: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
+        retry_after_s: Optional[float] = None,
+    ):
+        sv = engine.cfg.serving
+        self.engine = engine
+        self.metrics = metrics or ServingMetrics()
+        self.max_batch = int(max_batch_size or engine.max_batch)
+        self.max_wait_s = (
+            max_wait_ms if max_wait_ms is not None else sv.max_wait_ms
+        ) / 1e3
+        self.queue_depth = int(queue_depth or sv.queue_depth)
+        self.default_deadline_s = (
+            default_deadline_ms
+            if default_deadline_ms is not None
+            else sv.default_deadline_ms
+        ) / 1e3
+        self.retry_after_s = (
+            retry_after_s if retry_after_s is not None else sv.retry_after_s
+        )
+        self._q: Deque[_Pending] = deque()
+        self._cond = threading.Condition()
+        self._stop = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ----------------------------------------------------------- lifecycle
+    def start(self) -> "MicroBatcher":
+        if self._thread is not None:
+            return self
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="caption-batcher", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+        # Fail anything still queued so no submitter blocks forever.
+        with self._cond:
+            while self._q:
+                p = self._q.popleft()
+                if not p.future.done():
+                    p.future.set_exception(
+                        RuntimeError("batcher stopped")
+                    )
+
+    def __enter__(self) -> "MicroBatcher":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return len(self._q)
+
+    # -------------------------------------------------------------- submit
+    def submit(
+        self,
+        payload: Dict[str, Any],
+        deadline_ms: Optional[float] = None,
+    ) -> Dict[str, Any]:
+        """Blocking request entry point (one caller thread per in-flight
+        request — the HTTP front end's threading model).  Returns
+        ``{"caption", "tokens", "cached", "timings_ms"}``.
+
+        Raises ``ValueError``/``KeyError`` (bad input),
+        :class:`BackpressureError` (queue full) or
+        :class:`DeadlineExceededError`.
+        """
+        if self._thread is None:
+            raise RuntimeError("MicroBatcher not started")
+        t_submit = time.monotonic()
+        prepared = self.engine.prepare(payload)
+        hit = (
+            self.engine.lookup_caption(prepared.cache_key)
+            if prepared.cache_key
+            else None
+        )
+        if hit is not None:
+            self.metrics.requests_total.inc()
+            self.metrics.requests_served.inc()
+            total_ms = (time.monotonic() - t_submit) * 1e3
+            self.metrics.observe_stage("total", total_ms)
+            return {
+                "caption": hit["caption"],
+                "tokens": hit["tokens"],
+                "cached": True,
+                "timings_ms": {"total_ms": total_ms},
+            }
+        deadline_s = (
+            deadline_ms / 1e3
+            if deadline_ms is not None
+            else self.default_deadline_s
+        )
+        pending = _Pending(prepared, t_submit + deadline_s)
+        with self._cond:
+            if len(self._q) >= self.queue_depth:
+                self.metrics.requests_rejected.inc()
+                raise BackpressureError(self.retry_after_s)
+            self.metrics.requests_total.inc()
+            self._q.append(pending)
+            self._cond.notify_all()
+        # Generous slack: expiry is enforced by the scheduler (which
+        # owns the clock for queued requests) and by the engine-call
+        # bound below; the extra margin only matters if the scheduler
+        # thread died, in which case we surface a timeout.
+        try:
+            result = pending.future.result(timeout=deadline_s + 60.0)
+        except DeadlineExceededError:
+            raise
+        finally:
+            total_ms = (time.monotonic() - t_submit) * 1e3
+            self.metrics.observe_stage("total", total_ms)
+        return result
+
+    # ----------------------------------------------------------- scheduler
+    def _loop(self) -> None:
+        while True:
+            batch = self._collect()
+            if batch is None:
+                return
+            if batch:
+                self._dispatch(batch)
+
+    def _collect(self) -> Optional[List[_Pending]]:
+        """Block for the first request, then coalesce until the batch is
+        full or ``max_wait_ms`` has passed since that first arrival.
+        Returns None on stop."""
+        with self._cond:
+            while not self._q and not self._stop:
+                self._cond.wait(timeout=0.1)
+            if self._stop:
+                return None
+            t_first = self._q[0].t_enqueue
+            deadline = t_first + self.max_wait_s
+            while (
+                len(self._q) < self.max_batch
+                and not self._stop
+            ):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._cond.wait(timeout=remaining)
+            batch = []
+            while self._q and len(batch) < self.max_batch:
+                batch.append(self._q.popleft())
+            return batch
+
+    def _dispatch(self, batch: List[_Pending]) -> None:
+        now = time.monotonic()
+        live: List[_Pending] = []
+        for p in batch:
+            if now > p.deadline:
+                self.metrics.requests_expired.inc()
+                p.future.set_exception(
+                    DeadlineExceededError(
+                        "deadline exceeded while queued "
+                        f"({(now - p.t_enqueue) * 1e3:.0f}ms)"
+                    )
+                )
+            else:
+                live.append(p)
+                self.metrics.observe_stage(
+                    "queue", (now - p.t_enqueue) * 1e3
+                )
+        if not live:
+            return
+        try:
+            results = self.engine.decode_prepared(
+                [p.prepared for p in live]
+            )
+        except Exception as e:  # noqa: BLE001 — engine failure maps to 500s
+            self.metrics.requests_failed.inc(len(live))
+            for p in live:
+                if not p.future.done():
+                    p.future.set_exception(e)
+            return
+        n = len(live)
+        B = self.engine.bucket(n)
+        self.metrics.batches_total.inc()
+        self.metrics.batch_rows_total.inc(n)
+        self.metrics.batch_pad_rows_total.inc(B - n)
+        t = results[0].timings_ms if results else {}
+        for stage in ("pad", "device", "detok"):
+            if f"{stage}_ms" in t:
+                self.metrics.observe_stage(stage, t[f"{stage}_ms"])
+        for p, res in zip(live, results):
+            self.metrics.requests_served.inc()
+            if not p.future.done():
+                p.future.set_result({
+                    "caption": res.caption,
+                    "tokens": res.tokens,
+                    "cached": False,
+                    "timings_ms": dict(
+                        res.timings_ms,
+                        queue_ms=(now - p.t_enqueue) * 1e3,
+                        batch_size=n,
+                    ),
+                })

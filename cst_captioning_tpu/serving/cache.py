@@ -1,0 +1,114 @@
+"""Two-tier serving cache with hit/miss accounting.
+
+Tier 1 (``captions``) maps a request content hash — feature bytes +
+decode parameters — to the finished caption, so an identical request
+never reaches the queue at all.  Tier 2 (``features``) maps a
+client-supplied ``feature_id`` to the request's preprocessed feature
+rows AND (after the first decode) the projected encoder state
+(:class:`~cst_captioning_tpu.models.captioner.DecodeCache` rows), so a
+repeat request that only names the id skips both the feature upload and
+the encoder projections (``decoding.beam.beam_search_from_state``).
+
+Both tiers are plain LRU over an ``OrderedDict`` under one lock per
+tier — the working set is bounded by config
+(``ServingConfig.caption_cache_size`` / ``feature_cache_size``) and the
+values are host numpy, never device arrays, so eviction frees real
+memory immediately.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class LRUCache:
+    """Thread-safe LRU mapping with hit/miss counters."""
+
+    def __init__(self, capacity: int):
+        if capacity < 0:
+            raise ValueError(f"capacity {capacity} < 0")
+        self.capacity = capacity
+        self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._hits = 0
+        self._misses = 0
+
+    def get(self, key) -> Optional[Any]:
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+                self._hits += 1
+                return self._d[key]
+            self._misses += 1
+            return None
+
+    def put(self, key, value) -> None:
+        if self.capacity == 0:
+            return
+        with self._lock:
+            if key in self._d:
+                self._d.move_to_end(key)
+            self._d[key] = value
+            while len(self._d) > self.capacity:
+                self._d.popitem(last=False)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._d)
+
+    def __contains__(self, key) -> bool:
+        # Membership probe without touching recency or counters.
+        with self._lock:
+            return key in self._d
+
+    def clear(self) -> None:
+        with self._lock:
+            self._d.clear()
+
+    def stats(self) -> Dict[str, float]:
+        with self._lock:
+            hits, misses, size = self._hits, self._misses, len(self._d)
+        total = hits + misses
+        return {
+            "hits": hits,
+            "misses": misses,
+            "size": size,
+            "capacity": self.capacity,
+            "hit_rate": round(hits / total, 4) if total else 0.0,
+        }
+
+
+def content_key(
+    feats: Dict[str, np.ndarray], params_tag: str
+) -> str:
+    """Tier-1 key: sha1 over the (float32, contiguous) feature bytes of
+    every modality in sorted order, plus a decode-parameter tag (beam
+    size / max len / mode / checkpoint id) so a reconfigured engine
+    never serves a stale caption."""
+    h = hashlib.sha1()
+    h.update(params_tag.encode())
+    for m in sorted(feats):
+        a = np.ascontiguousarray(np.asarray(feats[m], np.float32))
+        h.update(m.encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+class TwoTierCache:
+    """``captions`` (tier 1) + ``features`` (tier 2); see module doc."""
+
+    def __init__(self, caption_capacity: int, feature_capacity: int):
+        self.captions = LRUCache(caption_capacity)
+        self.features = LRUCache(feature_capacity)
+
+    def stats(self) -> Dict[str, Dict[str, float]]:
+        return {
+            "captions": self.captions.stats(),
+            "features": self.features.stats(),
+        }

@@ -1,0 +1,538 @@
+"""Warm-model inference engine for online caption serving.
+
+Loads a checkpoint ONCE, pre-jits decode at a small ladder of fixed
+batch shapes, and exposes a synchronous ``decode_prepared`` the
+micro-batcher (``serving/batcher.py``) calls with a coalesced batch.
+
+Parity contract (the subsystem's correctness bar, pinned by
+``tests/test_serving.py``): a served caption is token-exact with what
+``evaluation.py`` produces offline for the same checkpoint and
+features.  Three properties carry it:
+
+* Per-request preprocessing is the OFFLINE preprocessing — the same
+  ``subsample_frames`` + zero-pad + mask as ``BatchIterator._assemble``.
+* The beam decode is dispatched through ``decoding/beam.py`` exactly as
+  ``evaluation.py`` dispatches it (same beam size / max len / length
+  normalization from ``EvalConfig``, same fused-kernel gate), and every
+  decode math op is row-independent, so padding a request batch up to a
+  ladder shape cannot change any live row's tokens.
+* The feature-cache fast path (tier 2: pre-encoded
+  :class:`~cst_captioning_tpu.models.captioner.DecodeCache` rows) feeds
+  ``beam_search_from_state`` — the literal tail of ``beam_search`` —
+  with encoder rows produced by the same jitted encode, and is pinned
+  token-exact against the from-features path.
+
+Shape-ladder rationale (docs/SERVING.md): every served batch pads up to
+the smallest ladder shape that fits, so the engine compiles at most
+``len(ladder)`` decode graphs per mode, ever — no recompiles under
+traffic, bounded XLA cache, and the padded-batch discipline that keeps
+TPU utilization high under the serving comparisons in PAPERS.md.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cst_captioning_tpu.config import Config
+from cst_captioning_tpu.data.loader import subsample_frames
+from cst_captioning_tpu.data.vocab import Vocabulary, decode_sequence
+from cst_captioning_tpu.decoding.beam import (
+    beam_search_from_state,
+    fused_beam_engaged,
+    make_beam_search_fn,
+)
+from cst_captioning_tpu.models.captioner import (
+    CaptionModel,
+    DecodeCache,
+    model_from_config,
+)
+from cst_captioning_tpu.serving.cache import TwoTierCache, content_key
+
+_log = logging.getLogger("cst_captioning_tpu.serving")
+
+
+class PreparedRequest(NamedTuple):
+    """A validated, preprocessed request row (host numpy)."""
+
+    feats: Optional[Dict[str, np.ndarray]]   # m -> (F, D_m) float32
+    masks: Optional[Dict[str, np.ndarray]]   # m -> (F,) float32
+    category: int
+    feature_id: Optional[str]
+    cache_key: str                           # tier-1 caption key
+    enc_row: Optional[Tuple[np.ndarray, ...]]  # tier-2 DecodeCache row
+
+
+class DecodedResult(NamedTuple):
+    caption: str
+    tokens: List[int]
+    timings_ms: Dict[str, float]
+
+
+def _default_ladder(max_batch: int) -> List[int]:
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return out
+
+
+class InferenceEngine:
+    """See module doc.  Thread-safety: ``decode_prepared`` is called
+    from the single batcher thread; ``prepare`` and the cache are safe
+    from any number of front-end threads."""
+
+    def __init__(
+        self,
+        cfg: Config,
+        params: Any = None,
+        checkpoint: str = "",
+        vocab: Optional[Vocabulary] = None,
+        cache: Optional[TwoTierCache] = None,
+        params_version: str = "0",
+        random_init: bool = False,
+        mesh=None,
+    ):
+        self.cfg = cfg
+        sv = cfg.serving
+        self.vocab = self._resolve_vocab(vocab)
+        if cfg.model.vocab_size == 0:
+            cfg.model.vocab_size = len(self.vocab)
+        self.model: CaptionModel = model_from_config(cfg, mesh=mesh)
+        if params is None:
+            if checkpoint:
+                params = self._restore(checkpoint)
+            elif random_init:
+                # Load-test / smoke server: fresh weights, noise captions.
+                params = self._init_random()
+            else:
+                raise ValueError(
+                    "InferenceEngine needs `params`, a `checkpoint` path, "
+                    "or random_init=True"
+                )
+        self.params = params
+        self.decode_mode = sv.decode_mode
+        if self.decode_mode not in ("beam", "greedy"):
+            raise ValueError(f"unknown decode_mode {self.decode_mode!r}")
+        self.max_batch = sv.max_batch_size or cfg.data.batch_size
+        ladder = sorted(set(sv.batch_shapes or _default_ladder(self.max_batch)))
+        if ladder[-1] != self.max_batch:
+            raise ValueError(
+                f"serving.batch_shapes top {ladder[-1]} != max_batch_size "
+                f"{self.max_batch} — the coalescer would build unservable "
+                "batches"
+            )
+        self.ladder = ladder
+        self.cache = cache or TwoTierCache(
+            sv.caption_cache_size, sv.feature_cache_size
+        )
+        # Everything that changes decoded tokens goes into the tier-1
+        # key tag, so a reconfigured/reloaded engine can never serve a
+        # stale caption for byte-identical features.
+        self.params_tag = (
+            f"{cfg.name}|{checkpoint or 'params'}|v{params_version}|"
+            f"{self.decode_mode}|K{cfg.eval.beam_size}|"
+            f"L{cfg.eval.max_decode_len}|ln{cfg.eval.length_normalize}"
+        )
+        self._feats_fns: Dict[int, Any] = {}
+        self._encode_fns: Dict[int, Any] = {}
+        self._state_fns: Dict[int, Any] = {}
+        self._fused_at: Dict[int, bool] = {}
+        if sv.warmup:
+            self.warmup()
+
+    # ------------------------------------------------------------ plumbing
+    def _resolve_vocab(self, vocab: Optional[Vocabulary]) -> Vocabulary:
+        if vocab is not None:
+            return vocab
+        d = self.cfg.data
+        if d.vocab_file:
+            return Vocabulary.load(d.vocab_file)
+        if d.dataset == "synthetic":
+            from cst_captioning_tpu.data.build import build_dataset
+
+            _, vb = build_dataset(self.cfg, self.cfg.eval.eval_split)
+            return vb
+        raise ValueError(
+            "no vocabulary: pass `vocab`, set data.vocab_file, or use the "
+            "synthetic dataset"
+        )
+
+    def _template_inputs(self):
+        cfg = self.cfg
+        feats = {
+            m: jnp.zeros((1, cfg.data.max_frames, dim))
+            for m, dim in cfg.data.feature_dims.items()
+        }
+        masks = {m: jnp.ones((1, cfg.data.max_frames)) for m in feats}
+        ids = jnp.zeros((1, 2), jnp.int32)
+        cat = (
+            jnp.zeros((1,), jnp.int32)
+            if self.model.use_category
+            else None
+        )
+        return feats, masks, ids, cat
+
+    def _init_random(self):
+        feats, masks, ids, cat = self._template_inputs()
+        return self.model.init(
+            jax.random.PRNGKey(self.cfg.train.seed), feats, masks, ids,
+            category=cat,
+        )
+
+    def _restore(self, checkpoint: str):
+        """Orbax params-only restore against an eval_shape template —
+        the exact ``cli/test.py`` loading path."""
+        from cst_captioning_tpu.training.checkpoint import restore_params
+
+        feats, masks, ids, cat = self._template_inputs()
+        template = jax.eval_shape(
+            lambda: self.model.init(
+                jax.random.PRNGKey(0), feats, masks, ids, category=cat
+            )
+        )
+        template = jax.tree.map(
+            lambda s: np.zeros(s.shape, s.dtype), template
+        )
+        return restore_params(checkpoint, template)
+
+    def bucket(self, n: int) -> int:
+        for b in self.ladder:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the ladder top {self.ladder[-1]}"
+        )
+
+    # ------------------------------------------------------- request prep
+    def prepare(self, payload: Dict[str, Any]) -> PreparedRequest:
+        """Validate + preprocess one request payload.
+
+        ``payload``: ``{"features": {modality: (F_m, D_m) array-like},
+        "feature_id": str?, "category": int?}``.  ``features`` may be
+        omitted when ``feature_id`` names a previously-seen request
+        (tier-2 hit).  Raises ``ValueError``/``KeyError`` on bad input —
+        the front end maps those to 4xx before anything is enqueued.
+        """
+        d = self.cfg.data
+        fid = payload.get("feature_id")
+        category = int(payload.get("category", 0) or 0)
+        raw = payload.get("features")
+        if raw is None:
+            if not fid:
+                raise ValueError("request needs `features` or `feature_id`")
+            entry = self.cache.features.get(fid)
+            if entry is None:
+                raise KeyError(
+                    f"feature_id {fid!r} not cached — resend `features`"
+                )
+            return PreparedRequest(
+                feats=entry["feats"],
+                masks=entry["masks"],
+                category=entry["category"],
+                feature_id=fid,
+                cache_key=entry["cache_key"],
+                enc_row=entry.get("enc"),
+            )
+        missing = [m for m in d.feature_modalities if m not in raw]
+        if missing:
+            raise ValueError(f"missing feature modalities: {missing}")
+        F = d.max_frames
+        feats: Dict[str, np.ndarray] = {}
+        masks: Dict[str, np.ndarray] = {}
+        for m in d.feature_modalities:
+            a = np.asarray(raw[m], np.float32)
+            if a.ndim == 1:  # single frame vector
+                a = a[None, :]
+            dim = d.feature_dims[m]
+            if a.ndim != 2 or a.shape[-1] != dim:
+                raise ValueError(
+                    f"modality {m!r}: expected (frames, {dim}), got "
+                    f"{a.shape}"
+                )
+            if a.shape[0] == 0:
+                raise ValueError(f"modality {m!r}: zero frames")
+            # EXACTLY BatchIterator._assemble's per-video path: uniform
+            # temporal subsample, zero-pad to max_frames, validity mask.
+            a = subsample_frames(a, F)
+            row = np.zeros((F, dim), np.float32)
+            row[: a.shape[0]] = a
+            mask = np.zeros((F,), np.float32)
+            mask[: a.shape[0]] = 1.0
+            feats[m] = row
+            masks[m] = mask
+        hash_input = dict(feats)
+        hash_input.update({f"__mask_{m}": v for m, v in masks.items()})
+        if self.model.use_category:
+            hash_input["__category"] = np.float32([category])
+        key = content_key(hash_input, self.params_tag)
+        enc = None
+        if fid:
+            entry = self.cache.features.get(fid)
+            if entry is not None:
+                enc = entry.get("enc")
+        req = PreparedRequest(
+            feats=feats,
+            masks=masks,
+            category=category,
+            feature_id=fid,
+            cache_key=key,
+            enc_row=enc,
+        )
+        if fid:
+            self.cache.features.put(fid, {
+                "feats": feats,
+                "masks": masks,
+                "category": category,
+                "cache_key": key,
+                "enc": req.enc_row,
+            })
+        return req
+
+    def lookup_caption(self, key: str) -> Optional[Dict[str, Any]]:
+        """Tier-1 probe (content hash -> finished result)."""
+        return self.cache.captions.get(key)
+
+    # ----------------------------------------------------------- jit cache
+    def _feats_fn(self, B: int):
+        if B not in self._feats_fns:
+            if self.decode_mode == "beam":
+                beam = make_beam_search_fn(
+                    self.model,
+                    beam_size=self.cfg.eval.beam_size,
+                    max_len=self.cfg.eval.max_decode_len,
+                    length_normalize=self.cfg.eval.length_normalize,
+                )
+                self._feats_fns[B] = (
+                    lambda p, f, m, c: beam(p, f, m, c).tokens
+                )
+            else:
+                from cst_captioning_tpu.training.steps import (
+                    make_greedy_sample_fn,
+                )
+
+                self._feats_fns[B] = make_greedy_sample_fn(
+                    self.model, self.cfg.eval.max_decode_len
+                )
+        return self._feats_fns[B]
+
+    def _encode_fn(self, B: int):
+        if B not in self._encode_fns:
+            model = self.model
+
+            @jax.jit
+            def encode(params, feats, masks, category):
+                _, cache = model.apply(
+                    params, feats, masks, category, method="init_decode"
+                )
+                return cache
+
+            self._encode_fns[B] = encode
+        return self._encode_fns[B]
+
+    def _state_fn(self, B: int):
+        if B not in self._state_fns:
+            model = self.model
+            ev = self.cfg.eval
+
+            @jax.jit
+            def from_state(params, cache):
+                from cst_captioning_tpu.models.captioner import DecodeState
+
+                cdt = jnp.dtype(model.compute_dtype)
+                n = cache.ctx_static.shape[0]
+                state = DecodeState(
+                    h=jnp.zeros((model.num_layers, n, model.rnn_size), cdt),
+                    c=jnp.zeros(
+                        (model.num_layers, n, model.rnn_size), jnp.float32
+                    ),
+                )
+                return beam_search_from_state(
+                    model, params, state, cache,
+                    beam_size=ev.beam_size,
+                    max_len=ev.max_decode_len,
+                    length_normalize=ev.length_normalize,
+                ).tokens
+
+            self._state_fns[B] = from_state
+        return self._state_fns[B]
+
+    def _fused(self, B: int, feats: Dict[str, jnp.ndarray]) -> bool:
+        if B not in self._fused_at:
+            engaged, _ = fused_beam_engaged(
+                self.model, feats, self.cfg.eval.beam_size
+            )
+            self._fused_at[B] = bool(engaged)
+        return self._fused_at[B]
+
+    def warmup(self) -> None:
+        """Pre-jit the whole ladder so the first real request never pays
+        XLA compile latency."""
+        d = self.cfg.data
+        t0 = time.perf_counter()
+        for B in self.ladder:
+            rows = [
+                PreparedRequest(
+                    feats={
+                        m: np.zeros((d.max_frames, d.feature_dims[m]),
+                                    np.float32)
+                        for m in d.feature_modalities
+                    },
+                    masks={
+                        m: np.concatenate(
+                            [np.ones((1,), np.float32),
+                             np.zeros((d.max_frames - 1,), np.float32)]
+                        )
+                        for m in d.feature_modalities
+                    },
+                    category=0,
+                    feature_id=None,
+                    cache_key="",
+                    enc_row=None,
+                )
+            ] * B
+            self.decode_prepared(rows, store=False)
+        _log.info(
+            "serving engine warm: ladder %s compiled in %.1fs",
+            self.ladder, time.perf_counter() - t0,
+        )
+
+    # --------------------------------------------------------------- decode
+    def _assemble(
+        self, reqs: Sequence[PreparedRequest], B: int
+    ) -> Tuple[Dict, Dict, Optional[jnp.ndarray]]:
+        """Stack request rows into a padded (B, ...) batch.  Padding rows
+        replicate row 0 (the loader's wrap-around trick): every row is a
+        valid decode input and row independence keeps live rows exact."""
+        n = len(reqs)
+        idx = list(range(n)) + [0] * (B - n)
+        feats = {
+            m: jnp.asarray(
+                np.stack([reqs[i].feats[m] for i in idx])
+            )
+            for m in self.cfg.data.feature_modalities
+        }
+        masks = {
+            m: jnp.asarray(
+                np.stack([reqs[i].masks[m] for i in idx])
+            )
+            for m in self.cfg.data.feature_modalities
+        }
+        cat = (
+            jnp.asarray(
+                np.asarray([reqs[i].category for i in idx], np.int32)
+            )
+            if self.model.use_category
+            else None
+        )
+        return feats, masks, cat
+
+    def decode_prepared(
+        self, reqs: Sequence[PreparedRequest], store: bool = True
+    ) -> List[DecodedResult]:
+        """Decode one coalesced batch (the batcher's unit of work).
+
+        Chooses between three equivalent backends:
+        * all rows carry cached encoder state and the scan beam path is
+          active -> ``beam_search_from_state`` (tier-2 fast path, skips
+          the encode GEMMs);
+        * beam mode otherwise -> the ``decoding/beam.py`` dispatch (the
+          offline path, fused kernel when its gate passes);
+        * greedy mode -> the validation greedy sampler.
+        """
+        if not reqs:
+            return []
+        n = len(reqs)
+        B = self.bucket(n)
+        t0 = time.perf_counter()
+        feats, masks, cat = self._assemble(reqs, B)
+        t_pad = time.perf_counter()
+
+        use_state_path = (
+            self.decode_mode == "beam"
+            and not self._fused(B, feats)
+        )
+        all_cached = use_state_path and all(
+            r.enc_row is not None for r in reqs
+        )
+        if all_cached:
+            idx = list(range(n)) + [0] * (B - n)
+            cache = DecodeCache(*(
+                jnp.asarray(np.stack([reqs[i].enc_row[f] for i in idx]))
+                for f in range(len(reqs[0].enc_row))
+            ))
+            tokens = self._state_fn(B)(self.params, cache)
+        elif use_state_path:
+            cache = self._encode_fn(B)(self.params, feats, masks, cat)
+            if store:
+                self._store_enc_rows(reqs, cache)
+            tokens = self._state_fn(B)(self.params, cache)
+        else:
+            tokens = self._feats_fn(B)(self.params, feats, masks, cat)
+        tokens = np.asarray(jax.device_get(tokens))[:n]
+        t_dev = time.perf_counter()
+        captions = decode_sequence(self.vocab, tokens)
+        t_detok = time.perf_counter()
+
+        timings = {
+            "pad_ms": (t_pad - t0) * 1e3,
+            "device_ms": (t_dev - t_pad) * 1e3,
+            "detok_ms": (t_detok - t_dev) * 1e3,
+        }
+        out = []
+        for i, r in enumerate(reqs):
+            res = DecodedResult(
+                caption=captions[i],
+                tokens=[int(t) for t in tokens[i]],
+                timings_ms=timings,
+            )
+            if store and r.cache_key:
+                self.cache.captions.put(
+                    r.cache_key,
+                    {"caption": res.caption, "tokens": res.tokens},
+                )
+            out.append(res)
+        return out
+
+    def _store_enc_rows(
+        self, reqs: Sequence[PreparedRequest], cache: DecodeCache
+    ) -> None:
+        """Persist per-request projected encoder rows into tier 2 so the
+        next request for the same ``feature_id`` skips the encode."""
+        rows_np = None
+        for i, r in enumerate(reqs):
+            if not r.feature_id or r.enc_row is not None:
+                continue
+            if rows_np is None:
+                rows_np = tuple(
+                    np.asarray(jax.device_get(f)) for f in cache
+                )
+            enc = tuple(f[i] for f in rows_np)
+            entry = self.cache.features.get(r.feature_id)
+            if entry is not None:
+                entry = dict(entry)
+                entry["enc"] = enc
+                self.cache.features.put(r.feature_id, entry)
+
+    # ----------------------------------------------------------- info
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "model": self.cfg.name,
+            "decode_mode": self.decode_mode,
+            "beam_size": self.cfg.eval.beam_size,
+            "max_decode_len": self.cfg.eval.max_decode_len,
+            "batch_ladder": self.ladder,
+            "modalities": {
+                m: self.cfg.data.feature_dims[m]
+                for m in self.cfg.data.feature_modalities
+            },
+            "max_frames": self.cfg.data.max_frames,
+            "vocab_size": len(self.vocab),
+            "backend": jax.default_backend(),
+        }

@@ -1,0 +1,206 @@
+"""Stdlib-only HTTP front end for the caption-serving subsystem.
+
+Endpoints:
+
+* ``POST /v1/caption`` — body ``{"features": {modality: [[...], ...]},
+  "feature_id": str?, "category": int?, "deadline_ms": float?}`` ->
+  ``{"caption", "tokens", "cached", "timings_ms"}``.  Errors: 400 (bad
+  input), 404 (unknown ``feature_id`` with no features), 429 (queue
+  full; ``Retry-After`` header set), 504 (deadline exceeded), 500
+  (engine failure).
+* ``GET /healthz`` — liveness + engine description.
+* ``GET /metrics`` — Prometheus text exposition (per-stage latency
+  histograms, request counters, cache tiers).
+* ``GET /stats``  — the same numbers as one JSON object.
+
+``ThreadingHTTPServer`` gives one thread per in-flight request, which
+matches :meth:`MicroBatcher.submit`'s blocking contract; the batcher's
+bounded queue — not the thread pool — is the backpressure surface.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional, Tuple
+
+from cst_captioning_tpu.serving.batcher import (
+    BackpressureError,
+    DeadlineExceededError,
+    MicroBatcher,
+)
+from cst_captioning_tpu.serving.engine import InferenceEngine
+from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+_log = logging.getLogger("cst_captioning_tpu.serving")
+
+MAX_BODY_BYTES = 64 * 1024 * 1024  # a 64-frame c3d payload is ~4MB of JSON
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server: "_Server"
+    protocol_version = "HTTP/1.1"
+
+    # ------------------------------------------------------------ plumbing
+    def log_message(self, fmt, *args):  # route access logs to logging
+        _log.debug("%s %s", self.address_string(), fmt % args)
+
+    def _send(
+        self,
+        code: int,
+        body: bytes,
+        content_type: str = "application/json",
+        headers: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(
+        self, code: int, obj: Any, headers: Optional[Dict[str, str]] = None
+    ) -> None:
+        self._send(
+            code, json.dumps(obj).encode(), "application/json", headers
+        )
+
+    # ------------------------------------------------------------ handlers
+    def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
+        srv = self.server
+        if self.path == "/healthz":
+            self._send_json(
+                200, {"status": "ok", **srv.engine.describe()}
+            )
+        elif self.path == "/metrics":
+            body = srv.metrics.to_prometheus(
+                srv.engine.cache.stats()
+            ).encode()
+            self._send(200, body, "text/plain; version=0.0.4")
+        elif self.path == "/stats":
+            self._send_json(
+                200,
+                srv.metrics.to_dict(srv.engine.cache.stats()),
+            )
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self) -> None:  # noqa: N802
+        if self.path != "/v1/caption":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", "0"))
+            if length <= 0 or length > MAX_BODY_BYTES:
+                self._send_json(
+                    400, {"error": f"bad Content-Length {length}"}
+                )
+                return
+            payload = json.loads(self.rfile.read(length))
+            if not isinstance(payload, dict):
+                raise ValueError("body must be a JSON object")
+        except (ValueError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request body: {e}"})
+            return
+        deadline_ms = payload.get("deadline_ms")
+        try:
+            result = self.server.batcher.submit(
+                payload, deadline_ms=deadline_ms
+            )
+            self._send_json(200, result)
+        except BackpressureError as e:
+            self._send_json(
+                429,
+                {"error": str(e), "retry_after_s": e.retry_after_s},
+                headers={"Retry-After": f"{e.retry_after_s:.3f}"},
+            )
+        except DeadlineExceededError as e:
+            self._send_json(504, {"error": str(e)})
+        except KeyError as e:
+            self._send_json(404, {"error": str(e)})
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": str(e)})
+        except Exception as e:  # noqa: BLE001 — last-resort 500
+            _log.exception("caption request failed")
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    engine: InferenceEngine
+    batcher: MicroBatcher
+    metrics: ServingMetrics
+
+
+class CaptionServer:
+    """Engine + batcher + HTTP listener, wired.  ``port=0`` binds an
+    ephemeral port (tests); ``serve_forever`` blocks, or use the
+    context manager / ``start``+``shutdown`` for in-process use."""
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        host: Optional[str] = None,
+        port: Optional[int] = None,
+        metrics: Optional[ServingMetrics] = None,
+        batcher: Optional[MicroBatcher] = None,
+    ):
+        sv = engine.cfg.serving
+        self.engine = engine
+        self.metrics = metrics or ServingMetrics()
+        self.batcher = batcher or MicroBatcher(engine, self.metrics)
+        self._http = _Server(
+            (host if host is not None else sv.host,
+             port if port is not None else sv.port),
+            _Handler,
+        )
+        self._http.engine = engine
+        self._http.batcher = self.batcher
+        self._http.metrics = self.metrics
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._http.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def start(self) -> "CaptionServer":
+        self.batcher.start()
+        self._thread = threading.Thread(
+            target=self._http.serve_forever,
+            name="caption-http",
+            daemon=True,
+        )
+        self._thread.start()
+        _log.info("caption server listening on %s", self.url)
+        return self
+
+    def serve_forever(self) -> None:
+        self.batcher.start()
+        _log.info("caption server listening on %s", self.url)
+        try:
+            self._http.serve_forever()
+        finally:
+            self.batcher.stop()
+
+    def shutdown(self) -> None:
+        self._http.shutdown()
+        self._http.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.batcher.stop()
+
+    def __enter__(self) -> "CaptionServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()
