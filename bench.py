@@ -28,8 +28,9 @@ BENCH_CHUNK (steps per dispatch), BENCH_ITERS, BENCH_PALLAS,
 BENCH_CST=0 to skip the CST section, BENCH_ATTN=0 to skip the
 attention-fusion XE bench (it compiles a second model), BENCH_DECODE=0
 to skip greedy/beam decode throughput, BENCH_SERVING=0 to skip the
-online-serving offered-load sweep (BENCH_SERVING_REQS /
-BENCH_SERVING_CLIENTS size it), BENCH_LOADER=0 to skip the
+online-serving continuous-vs-ladder sweep (BENCH_SERVING_REQS /
+BENCH_SERVING_CLIENTS / BENCH_SERVING_OPEN_N size it), BENCH_LOADER=0
+to skip the
 packed-loader assembly bench, BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -491,22 +492,43 @@ def bench_decode():
 
 
 def bench_serving():
-    """Serving subsystem offered-load sweep (serving/): N concurrent
-    closed-loop clients through the micro-batcher + warm engine ->
-    captions/s and p50/p99 end-to-end latency, plus the queue/device
-    latency split and the cache hit rate from /metrics' counters.
+    """Serving subsystem sweep (serving/): CONTINUOUS in-flight batching
+    (slot loop) vs the batch-at-a-time shape LADDER, paired row for row
+    on the same engine, same mixed-length synthetic workload, same
+    offered load.
+
+    Workload: random weights decode almost every caption to the length
+    cap, which would hide what continuous batching is for — so the EOS
+    logit bias is calibrated (bisection) until ~75% of a feature pool
+    decodes short (slot occupancy <= L/2) and ~25% rides to the cap,
+    approximating the MSR-VTT short-caption/long-cap regime.  Each
+    measured request is a unique pool item (tier-1 hits would otherwise
+    dominate both modes and mask the decode comparison).
+
+    Two load patterns per mode:
+    * closed-loop: N clients, back-to-back requests -> max sustained
+      captions/s (capacity) + p50/p99;
+    * open-loop: a fixed arrival schedule at the geometric mean of the
+      two measured capacities — an offered load the ladder cannot
+      sustain but the slot loop can — plus a 0.6x-ladder-capacity
+      underload control point.
 
     On TPU the engine runs the MSR-VTT shape (driver config 5: beam-5,
-    resnet+c3d); on CPU hosts it drops to the synthetic-smoke shape so
-    the sweep stays seconds, and records which shape ran.  Random
-    weights — serving throughput is caption-content-independent.
-    Env: BENCH_SERVING_REQS (requests per client per point, default 6),
-    BENCH_SERVING_CLIENTS (sweep points, default "2,8,16")."""
+    resnet+c3d); on CPU hosts a small-but-not-trivial shape
+    (rnn256/V2048/K3/L24) keeps device step time above dispatch noise
+    while the sweep stays seconds; `serving_shape` records which ran.
+    Env: BENCH_SERVING_REQS (requests per client per closed-loop point,
+    default 8), BENCH_SERVING_CLIENTS (default "2,8,16"),
+    BENCH_SERVING_OPEN_N (open-loop requests per point, default 300)."""
     import threading
 
     from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.constants import EOS_ID, PAD_ID
     from cst_captioning_tpu.data.vocab import Vocabulary
-    from cst_captioning_tpu.serving.batcher import MicroBatcher
+    from cst_captioning_tpu.serving.batcher import (
+        ContinuousBatcher,
+        MicroBatcher,
+    )
     from cst_captioning_tpu.serving.engine import InferenceEngine
     from cst_captioning_tpu.serving.metrics import ServingMetrics
 
@@ -519,19 +541,39 @@ def bench_serving():
         )
         cfg.serving.max_batch_size = cfg.data.batch_size
         cfg.serving.batch_shapes = [8, 16, 32, 64]
+        cfg.serving.num_slots = cfg.data.batch_size
         shape = "msrvtt"
     else:
+        # Small-but-real CPU shape: one decode step at S*K rows costs
+        # ~1ms, so the continuous/ladder split measures decode steps,
+        # not python dispatch.
         cfg = get_preset("synthetic_smoke")
-        vocab = None
+        cfg.model.rnn_size = 256
+        cfg.model.input_encoding_size = 256
+        cfg.model.att_hidden_size = 256
+        cfg.data.feature_dims = {"resnet": 512}
+        cfg.data.max_frames = 16
+        cfg.eval.beam_size = 3
+        cfg.eval.max_decode_len = 24
+        vocab = Vocabulary([f"w{i}" for i in range(2044)])
+        cfg.model.vocab_size = len(vocab)
+        cfg.serving.max_batch_size = 8
+        cfg.serving.batch_shapes = [1, 2, 4, 8]
+        cfg.serving.num_slots = 8
         shape = "smoke"
     cfg.serving.max_wait_ms = 5.0
-    cfg.serving.queue_depth = 2048  # sweep measures latency, not rejects
+    cfg.serving.queue_depth = 4096  # sweep measures latency, not rejects
+    cfg.serving.slot_block_steps = 2
     cfg.serving.warmup = True
+    cfg.serving.continuous = True   # warmup covers BOTH dispatch paths
     engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    decoder = engine.slot_decoder()
+    L = cfg.eval.max_decode_len
 
-    # Unique-feature pool + 25% repeats so tier-1 sees realistic reuse.
+    # ---------------- mixed-length workload calibration ----------------
     rng = np.random.RandomState(17)
     F = cfg.data.max_frames
+    n_pool = 128
     pool = [
         {
             "features": {
@@ -539,27 +581,107 @@ def bench_serving():
                 for m, d in cfg.data.feature_dims.items()
             }
         }
-        for _ in range(32)
+        for _ in range(n_pool)
     ]
+    prepared = [engine.prepare(q) for q in pool]
+    base_logit_b = np.asarray(engine.params["params"]["logit_b"]).copy()
 
-    reqs_per_client = int(os.environ.get("BENCH_SERVING_REQS", "6"))
-    clients = [
-        int(c)
-        for c in os.environ.get("BENCH_SERVING_CLIENTS", "2,8,16").split(",")
-    ]
-    out = {"serving_shape": shape}
-    sweep = {}
-    for n_clients in clients:
+    def set_eos_bias(delta):
+        b = base_logit_b.copy()
+        b[EOS_ID] += delta
+        p = dict(engine.params)
+        pp = dict(p["params"])
+        pp["logit_b"] = jnp.asarray(b)
+        p["params"] = pp
+        engine.params = p
+
+    def slot_occupancy(idx):
+        """Decode steps until each request's slot frees — the quantity
+        continuous batching actually saves (for beam: until the LAST
+        beam finishes, not the winning caption's length)."""
+        steps = {}
+        pending = list(idx)
+        while pending or decoder.occupied:
+            adm = []
+            while pending and len(adm) < min(
+                len(decoder.free), decoder.admit_cap
+            ):
+                adm.append(pending.pop())
+            done = decoder.tick([prepared[i] for i in adm], adm)
+            for i, _, _, st in decoder.harvest_many(done):
+                steps[i] = st
+        return np.asarray([steps[i] for i in idx])
+
+    probe = list(range(32))
+    lo, hi = 0.0, 8.0
+    for _ in range(9):
+        mid = (lo + hi) / 2
+        set_eos_bias(mid)
+        frac_short = float((slot_occupancy(probe) <= L // 2).mean())
+        if frac_short < 0.75:
+            lo = mid
+        else:
+            hi = mid
+    eos_bias = hi
+    set_eos_bias(eos_bias)
+    occ = slot_occupancy(list(range(n_pool)))
+    short = [i for i in range(n_pool) if occ[i] <= L // 2]
+    long_ = [i for i in range(n_pool) if occ[i] > L // 2]
+    if not short or not long_:
+        # Degenerate weights: fall back to an unlabeled pool; the rows
+        # still pair, the short/long split is just absent.
+        short = short or list(range(n_pool))
+        long_ = long_ or list(range(n_pool))
+    workload = {
+        "eos_bias": round(eos_bias, 4),
+        "pool": n_pool,
+        "short": len(short),
+        "long": len(long_),
+        "mean_occupancy_steps": round(float(occ.mean()), 2),
+        "max_steps": L,
+    }
+
+    def picks(n, seed):
+        """75/25 short/long mixed draw (unique-leaning)."""
+        r = np.random.RandomState(seed)
+        n_long = max(1, int(round(n * 0.25)))
+        ks = list(r.choice(long_, size=n_long, replace=True))
+        ks += list(r.choice(short, size=n - n_long, replace=True))
+        r.shuffle(ks)
+        return ks
+
+    def make_batcher(mode, metrics):
+        cls = ContinuousBatcher if mode == "continuous" else MicroBatcher
+        return cls(engine, metrics)
+
+    def summarize(lat_ms, wall, metrics, errors):
+        return {
+            "captions_per_sec": round(len(lat_ms) / wall, 2)
+            if wall > 0 else None,
+            "p50_ms": round(np.percentile(lat_ms, 50), 2)
+            if lat_ms else None,
+            "p99_ms": round(np.percentile(lat_ms, 99), 2)
+            if lat_ms else None,
+            "served": metrics.requests_served.value,
+            "steps_per_caption": round(
+                metrics.steps_per_caption.snapshot()["mean_ms"], 2
+            ),
+            "errors": len(errors),
+            "error_sample": errors[:3],
+        }
+
+    def run_closed(mode, n_clients, reqs_per_client):
+        engine.cache.captions.clear()
         metrics = ServingMetrics()
-        batcher = MicroBatcher(engine, metrics)
+        batcher = make_batcher(mode, metrics)
         lat_ms, errors = [], []
         lock = threading.Lock()
+        assign = {
+            c: picks(reqs_per_client, 1000 + c) for c in range(n_clients)
+        }
 
-        def client(cid, batcher=batcher, lat_ms=lat_ms, errors=errors):
-            r = np.random.RandomState(1000 + cid)
-            for i in range(reqs_per_client):
-                # ~25% of traffic re-requests a recently-seen payload.
-                k = r.randint(8) if r.rand() < 0.25 else r.randint(len(pool))
+        def client(cid):
+            for k in assign[cid]:
                 t0 = time.perf_counter()
                 try:
                     batcher.submit(pool[k], deadline_ms=120_000.0)
@@ -581,38 +703,110 @@ def bench_serving():
             for t in threads:
                 t.join()
             wall = time.perf_counter() - t0
-        cache = engine.cache.stats()
-        sweep[f"clients{n_clients}"] = {
-            "captions_per_sec": round(len(lat_ms) / wall, 2),
-            "p50_ms": round(np.percentile(lat_ms, 50), 2) if lat_ms else None,
-            "p99_ms": round(np.percentile(lat_ms, 99), 2) if lat_ms else None,
-            "queue_p50_ms": round(
-                metrics.stages["queue"].percentile(50), 2
-            ),
-            "device_p50_ms": round(
-                metrics.stages["device"].percentile(50), 2
-            ),
-            "mean_batch": round(metrics.mean_batch_size(), 2),
-            "served": metrics.requests_served.value,
-            "errors": len(errors),
-        }
-        if n_clients == 8:
-            out.update({
-                "serving_captions_per_sec": sweep["clients8"][
-                    "captions_per_sec"
-                ],
-                "serving_p50_ms": sweep["clients8"]["p50_ms"],
-                "serving_p99_ms": sweep["clients8"]["p99_ms"],
-                "serving_queue_p50_ms": round(
-                    metrics.stages["queue"].percentile(50), 2
-                ),
-                "serving_device_p50_ms": round(
-                    metrics.stages["device"].percentile(50), 2
-                ),
-                "serving_mean_batch": round(metrics.mean_batch_size(), 2),
-                "serving_cache_hit_rate": cache["captions"]["hit_rate"],
-                "serving_dropped_live": metrics.requests_failed.value,
-            })
+        point = summarize(lat_ms, wall, metrics, errors)
+        point["queue_p50_ms"] = round(
+            metrics.stages[
+                "admission" if mode == "continuous" else "queue"
+            ].percentile(50), 2,
+        )
+        point["device_p50_ms"] = round(
+            metrics.stages["device"].percentile(50), 2
+        )
+        point["mean_batch"] = round(metrics.mean_batch_size(), 2)
+        return point
+
+    def run_open(mode, rate_cps, n):
+        """Fixed arrival schedule — the literal same offered load for
+        both modes."""
+        engine.cache.captions.clear()
+        metrics = ServingMetrics()
+        batcher = make_batcher(mode, metrics)
+        lat_ms, errors = [], []
+        lock = threading.Lock()
+        ks = picks(n, 11)
+
+        def worker(k):
+            t0 = time.perf_counter()
+            try:
+                batcher.submit(pool[k], deadline_ms=120_000.0)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+                return
+            with lock:
+                lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        with batcher:
+            threads = []
+            t_start = time.perf_counter()
+            for i, k in enumerate(ks):
+                target = t_start + i / rate_cps
+                now = time.perf_counter()
+                if target > now:
+                    time.sleep(target - now)
+                th = threading.Thread(target=worker, args=(k,))
+                th.start()
+                threads.append(th)
+            for th in threads:
+                th.join()
+            wall = time.perf_counter() - t_start
+        point = summarize(lat_ms, wall, metrics, errors)
+        point["offered_cps"] = round(rate_cps, 1)
+        return point
+
+    reqs_per_client = int(os.environ.get("BENCH_SERVING_REQS", "8"))
+    clients = [
+        int(c)
+        for c in os.environ.get("BENCH_SERVING_CLIENTS", "2,8,16").split(",")
+    ]
+    open_n = int(os.environ.get("BENCH_SERVING_OPEN_N", "300"))
+
+    out = {"serving_shape": shape, "serving_workload": workload}
+    sweep = {"continuous": {}, "ladder": {}}
+    for n_clients in clients:
+        for mode in ("continuous", "ladder"):
+            sweep[mode][f"clients{n_clients}"] = run_closed(
+                mode, n_clients, reqs_per_client
+            )
+
+    # Open loop: pick the offered load between the two measured
+    # capacities (the region continuous mode unlocks) + an underload
+    # control at 0.6x ladder capacity.
+    top = f"clients{max(clients)}"
+    lad_cap = sweep["ladder"][top]["captions_per_sec"] or 1.0
+    cont_cap = sweep["continuous"][top]["captions_per_sec"] or 1.0
+    mid_rate = float(np.sqrt(lad_cap * cont_cap))
+    for name, rate in (
+        ("underload", 0.6 * lad_cap),
+        ("over_ladder_capacity", mid_rate),
+    ):
+        for mode in ("continuous", "ladder"):
+            sweep[mode][f"open_{name}"] = run_open(mode, rate, open_n)
+
+    # Headline extras: the paired open-loop point (same offered load)
+    # and the closed-loop capacity split.
+    oc = sweep["continuous"]["open_over_ladder_capacity"]
+    ol = sweep["ladder"]["open_over_ladder_capacity"]
+    c8 = sweep["continuous"].get("clients8") or sweep["continuous"][top]
+    out.update({
+        "serving_captions_per_sec": c8["captions_per_sec"],
+        "serving_p50_ms": c8["p50_ms"],
+        "serving_p99_ms": c8["p99_ms"],
+        "serving_capacity_continuous": cont_cap,
+        "serving_capacity_ladder": lad_cap,
+        "serving_capacity_ratio": round(cont_cap / lad_cap, 3),
+        "serving_offered_load_cps": round(mid_rate, 1),
+        "serving_offered_p99_continuous_ms": oc["p99_ms"],
+        "serving_offered_p99_ladder_ms": ol["p99_ms"],
+        "serving_offered_p99_ratio": round(
+            (ol["p99_ms"] or 0.0) / oc["p99_ms"], 3
+        ) if oc["p99_ms"] else None,
+        "serving_steps_per_caption": oc["steps_per_caption"],
+        "serving_max_decode_len": L,
+        "serving_dropped_live": (
+            oc["errors"] + ol["errors"]
+        ),
+    })
     out["serving_sweep"] = sweep
     return out
 

@@ -5,9 +5,13 @@
       [--serving.port 8000] [--serving.max_wait_ms 8] \\
       [--serving.decode_mode beam]
 
-Loads the checkpoint once, pre-jits the batch-shape ladder, and serves
+Loads the checkpoint once, pre-jits the decode paths, and serves
 ``POST /v1/caption`` (plus ``/healthz``, ``/metrics``, ``/stats``)
-through the micro-batching scheduler — see docs/SERVING.md.
+through the continuous in-flight batching scheduler (slot-based
+persistent decode; ``--serving.continuous false`` falls back to the
+batch-at-a-time shape ladder) — see docs/SERVING.md.  SIGTERM drains
+gracefully: admissions 503, in-flight work finishes within
+``--serving.drain_timeout_s``.
 
 ``--random-init`` serves freshly-initialized weights instead of a
 checkpoint (load testing / smoke runs only — the captions are noise).

@@ -201,6 +201,25 @@ class ServingConfig:
     # eval path token-exactly (the serving parity contract); "greedy"
     # is the cheaper validation-style decode.
     decode_mode: str = "beam"
+    # Continuous in-flight batching (serving/slots.py): a persistent
+    # matrix of decode slots stepped one decode step at a time — slots
+    # free as soon as their caption hits EOS (short captions exit in
+    # ~length steps instead of max_decode_len) and new requests are
+    # admitted at the next step boundary instead of the next batch
+    # boundary.  False = the PR-2 batch-at-a-time shape ladder.
+    continuous: bool = True
+    # Decode slots for continuous mode (greedy: 1 row/slot; beam: K
+    # contiguous rows/slot).  0 = max_batch_size.
+    num_slots: int = 0
+    # Device decode steps per jitted slot-loop call (>=1).  Raising it
+    # amortizes per-call dispatch + host-sync overhead at the price of
+    # admission/exit granularity (a finished slot rides frozen for up
+    # to N-1 extra steps — parity-neutral, the freeze is a no-op).
+    slot_block_steps: int = 1
+    # Graceful-shutdown drain budget: on SIGTERM/shutdown the server
+    # stops admissions (503), lets in-flight work finish for up to this
+    # many seconds, then exits.
+    drain_timeout_s: float = 30.0
     # Fixed batch shapes the engine pre-jits (ascending).  Empty = a
     # power-of-two ladder 1, 2, 4, ... up to max_batch_size.  Every
     # served batch is padded up to the smallest ladder shape that fits,
@@ -213,6 +232,11 @@ class ServingConfig:
     retry_after_s: float = 0.25   # hint returned on queue-full rejects
     caption_cache_size: int = 4096   # tier-1: content hash -> caption
     feature_cache_size: int = 512    # tier-2: feature id -> encoder state
+    # Tier-2 byte budget (0 = entry-count bound only).  Projected
+    # DecodeCache rows are the largest cached objects — bound the tier
+    # by what it actually holds, not how many entries it has; evictions
+    # are counted and exported on /metrics.
+    feature_cache_bytes: int = 0
     warmup: bool = True           # pre-jit the whole ladder at startup
 
 
@@ -362,6 +386,10 @@ def _preset_msrvtt_serve() -> Config:
     c.serving.queue_depth = 1024
     c.serving.caption_cache_size = 65536
     c.serving.feature_cache_size = 4096
+    # ~64KB/row projected f32 DecodeCache at MSR-VTT shape; cap the tier
+    # at 256MiB of host RAM regardless of entry count.
+    c.serving.feature_cache_bytes = 256 * 1024 * 1024
+    c.serving.num_slots = 64
     return c
 
 
@@ -390,6 +418,12 @@ def _preset_synthetic_smoke() -> Config:
     c.serving.queue_depth = 32
     c.serving.caption_cache_size = 64
     c.serving.feature_cache_size = 16
+    c.serving.feature_cache_bytes = 1024 * 1024
+    c.serving.num_slots = 4
+    # Block of 2 decode steps per slot-loop call: exercises the
+    # frozen-ride parity path in tier-1 and halves per-call overhead.
+    c.serving.slot_block_steps = 2
+    c.serving.drain_timeout_s = 60.0
     return c
 
 

@@ -6,14 +6,21 @@ north star ("serves heavy traffic") needs, built around the same padded
 fixed-shape discipline as training:
 
 * ``engine``  — warm-model inference engine: loads an orbax checkpoint
-  once, pre-jits greedy/beam decode at a ladder of fixed batch shapes,
-  and exposes a synchronous ``decode_batch``.  A served caption is
-  token-exact with the offline ``evaluation.py`` beam path for the same
-  checkpoint/features (the serving parity contract, pinned in
+  once, pre-jits greedy/beam decode at a ladder of fixed batch shapes
+  (plus the slot loop's fns in continuous mode), and exposes
+  ``decode_prepared`` (ladder) and the slot-loop helpers.  A served
+  caption is token-exact with the offline ``evaluation.py`` decode for
+  the same checkpoint/features (the serving parity contract, pinned in
   ``tests/test_serving.py``).
-* ``batcher`` — micro-batching scheduler: bounded queue, batch-size /
-  ``max_wait_ms`` coalescing, shape-bucket padding, per-request
-  deadlines + cancellation, reject-with-retry-after backpressure.
+* ``batcher`` — request schedulers over one bounded admission queue:
+  ``ContinuousBatcher`` (continuous in-flight batching into the slot
+  loop — the default) and ``MicroBatcher`` (batch-at-a-time shape
+  ladder fallback); per-request deadlines + cancellation,
+  reject-with-retry-after backpressure, graceful drain.
+* ``slots``   — the persistent slot-based decode loop behind
+  continuous mode: S device-resident decode slots stepped one decode
+  step at a time, freed on EOS/length-cap, refilled by
+  ``dynamic_update_slice`` admission at step boundaries.
 * ``cache``   — two-tier LRU: content-hash -> decoded caption, and
   feature-id -> projected encoder state (skips the encode GEMMs on the
   scan beam path via ``decoding.beam.beam_search_from_state``).
@@ -29,13 +36,17 @@ Architecture notes and the capacity/latency model live in
 
 from cst_captioning_tpu.serving.batcher import (  # noqa: F401
     BackpressureError,
+    ContinuousBatcher,
     DeadlineExceededError,
     MicroBatcher,
+    ShuttingDownError,
 )
 from cst_captioning_tpu.serving.cache import LRUCache, TwoTierCache  # noqa: F401
 from cst_captioning_tpu.serving.engine import InferenceEngine  # noqa: F401
 from cst_captioning_tpu.serving.metrics import (  # noqa: F401
+    Gauge,
     LatencyHistogram,
     ServingMetrics,
 )
 from cst_captioning_tpu.serving.server import CaptionServer  # noqa: F401
+from cst_captioning_tpu.serving.slots import SlotDecoder  # noqa: F401

@@ -1,16 +1,25 @@
-"""Async micro-batching scheduler over the inference engine.
+"""Request scheduling over the inference engine: one bounded admission
+queue, two dispatch disciplines.
 
-Requests from any number of front-end threads enter a BOUNDED queue; a
-single scheduler thread coalesces them into fixed-shape batches for
-``InferenceEngine.decode_prepared``:
+Requests from any number of front-end threads enter a BOUNDED queue
+(`submit` blocks the caller until its caption resolves — the HTTP front
+end's thread-per-request model).  A single scheduler thread drains it
+under one of two disciplines:
 
-* **Coalescing**: the scheduler sleeps until a request arrives, then
-  waits at most ``max_wait_ms`` past the FIRST queued request's arrival
-  for the batch to fill to ``max_batch_size`` — the classic
-  latency/utilization dial.  A full batch dispatches immediately.
-* **Shape buckets**: a drained batch of n requests pads up to the
-  engine's smallest ladder shape >= n, so the device only ever sees
-  pre-compiled shapes (engine.py owns the padding).
+* :class:`MicroBatcher` — the PR-2 shape-ladder fallback
+  (``serving.continuous = false``): coalesce up to ``max_batch_size``
+  requests for at most ``max_wait_ms``, pad to the engine's ladder, and
+  run the batch TO COMPLETION (``InferenceEngine.decode_prepared``).
+* :class:`ContinuousBatcher` — continuous in-flight batching
+  (``serving.continuous = true``, the default): the queue feeds a
+  persistent :class:`~cst_captioning_tpu.serving.slots.SlotDecoder`;
+  pending requests are admitted into free decode slots at STEP
+  boundaries and every caption's slot frees the moment its rows hit EOS
+  or the length cap — no run-to-completion barrier, no head-of-line
+  blocking behind a long caption.
+
+Shared semantics (both disciplines, pinned by tests):
+
 * **Deadlines + cancellation**: every request carries an absolute
   deadline (``default_deadline_ms`` unless the client set one).  A
   request that expires while queued is dropped BEFORE it wastes device
@@ -20,6 +29,10 @@ single scheduler thread coalesces them into fixed-shape batches for
   layer maps it to 429 + ``Retry-After``.  Nothing non-expired that was
   ACCEPTED is ever dropped (the zero-drop contract in the tier-1 load
   test).
+* **Graceful drain**: ``stop()`` (and SIGTERM via the server) stops
+  admissions — new submits raise :class:`ShuttingDownError` (HTTP 503)
+  — then lets queued + in-flight work finish within
+  ``drain_timeout_s`` before failing whatever remains.
 
 Tier-1 cache hits short-circuit in ``submit`` — an identical request
 returns without touching the queue or the device.
@@ -27,14 +40,16 @@ returns without touching the queue or the device.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
-from concurrent.futures import Future
 from typing import Any, Deque, Dict, List, Optional
 
 from cst_captioning_tpu.serving.engine import InferenceEngine
 from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+_log = logging.getLogger("cst_captioning_tpu.serving")
 
 
 class BackpressureError(Exception):
@@ -51,38 +66,43 @@ class DeadlineExceededError(Exception):
     """The request's deadline passed before a result was produced."""
 
 
+class ShuttingDownError(Exception):
+    """The server is draining — no new requests are admitted (503)."""
+
+
 class _Pending:
-    __slots__ = ("prepared", "future", "t_enqueue", "deadline")
+    __slots__ = ("prepared", "future", "t_enqueue", "t_admit", "deadline")
 
     def __init__(self, prepared, deadline: float):
+        from concurrent.futures import Future
+
         self.prepared = prepared
         self.future: "Future[Dict[str, Any]]" = Future()
         self.t_enqueue = time.monotonic()
+        self.t_admit = 0.0
         self.deadline = deadline
 
 
-class MicroBatcher:
-    """See module doc.  One instance per engine; start() spawns the
-    scheduler thread, stop() drains it."""
+class _BatcherBase:
+    """Bounded admission queue + submit/deadline/backpressure/drain
+    semantics shared by both dispatch disciplines.  Subclasses implement
+    ``_loop`` (the scheduler thread body)."""
+
+    _thread_name = "caption-scheduler"
 
     def __init__(
         self,
         engine: InferenceEngine,
         metrics: Optional[ServingMetrics] = None,
         *,
-        max_batch_size: Optional[int] = None,
-        max_wait_ms: Optional[float] = None,
         queue_depth: Optional[int] = None,
         default_deadline_ms: Optional[float] = None,
         retry_after_s: Optional[float] = None,
+        drain_timeout_s: Optional[float] = None,
     ):
         sv = engine.cfg.serving
         self.engine = engine
         self.metrics = metrics or ServingMetrics()
-        self.max_batch = int(max_batch_size or engine.max_batch)
-        self.max_wait_s = (
-            max_wait_ms if max_wait_ms is not None else sv.max_wait_ms
-        ) / 1e3
         self.queue_depth = int(queue_depth or sv.queue_depth)
         self.default_deadline_s = (
             default_deadline_ms
@@ -92,30 +112,57 @@ class MicroBatcher:
         self.retry_after_s = (
             retry_after_s if retry_after_s is not None else sv.retry_after_s
         )
+        self.drain_timeout_s = (
+            drain_timeout_s
+            if drain_timeout_s is not None
+            else sv.drain_timeout_s
+        )
         self._q: Deque[_Pending] = deque()
         self._cond = threading.Condition()
         self._stop = False
+        self._drain = True          # serve remaining work on stop
+        self._draining = False      # admissions closed
         self._thread: Optional[threading.Thread] = None
 
     # ----------------------------------------------------------- lifecycle
-    def start(self) -> "MicroBatcher":
+    def start(self):
         if self._thread is not None:
             return self
         self._stop = False
+        self._draining = False
         self._thread = threading.Thread(
-            target=self._loop, name="caption-batcher", daemon=True
+            target=self._run, name=self._thread_name, daemon=True
         )
         self._thread.start()
         return self
 
-    def stop(self) -> None:
+    def begin_drain(self) -> None:
+        """Close admissions (new ``submit`` -> 503) without blocking;
+        queued and in-flight requests keep being served."""
         with self._cond:
+            self._draining = True
+            self._cond.notify_all()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def stop(self, drain: bool = True) -> None:
+        """Shut the scheduler down.  ``drain=True`` (default): close
+        admissions, serve queued + in-flight work for up to
+        ``drain_timeout_s``, then exit; ``drain=False``: fail queued
+        requests immediately (in-flight device work still completes —
+        a dispatched computation cannot be interrupted)."""
+        with self._cond:
+            self._draining = True
+            self._drain = drain
             self._stop = True
             self._cond.notify_all()
         if self._thread is not None:
-            self._thread.join(timeout=30.0)
+            self._thread.join(timeout=self.drain_timeout_s + 60.0)
             self._thread = None
-        # Fail anything still queued so no submitter blocks forever.
+        # Fail anything still queued so no submitter blocks forever
+        # (drain disabled, drain deadline blown, or scheduler death).
         with self._cond:
             while self._q:
                 p = self._q.popleft()
@@ -124,7 +171,7 @@ class MicroBatcher:
                         RuntimeError("batcher stopped")
                     )
 
-    def __enter__(self) -> "MicroBatcher":
+    def __enter__(self):
         return self.start()
 
     def __exit__(self, *exc) -> None:
@@ -146,11 +193,14 @@ class MicroBatcher:
         ``{"caption", "tokens", "cached", "timings_ms"}``.
 
         Raises ``ValueError``/``KeyError`` (bad input),
-        :class:`BackpressureError` (queue full) or
-        :class:`DeadlineExceededError`.
+        :class:`BackpressureError` (queue full),
+        :class:`DeadlineExceededError` or :class:`ShuttingDownError`
+        (drain in progress).
         """
         if self._thread is None:
-            raise RuntimeError("MicroBatcher not started")
+            raise RuntimeError(f"{type(self).__name__} not started")
+        if self._draining:
+            raise ShuttingDownError("server is draining")
         t_submit = time.monotonic()
         prepared = self.engine.prepare(payload)
         hit = (
@@ -176,6 +226,8 @@ class MicroBatcher:
         )
         pending = _Pending(prepared, t_submit + deadline_s)
         with self._cond:
+            if self._draining:
+                raise ShuttingDownError("server is draining")
             if len(self._q) >= self.queue_depth:
                 self.metrics.requests_rejected.inc()
                 raise BackpressureError(self.retry_after_s)
@@ -196,6 +248,66 @@ class MicroBatcher:
         return result
 
     # ----------------------------------------------------------- scheduler
+    def _run(self) -> None:
+        try:
+            self._loop()
+        except Exception:  # noqa: BLE001 — scheduler death is fatal
+            _log.exception("scheduler thread died")
+            with self._cond:
+                self._draining = True
+                while self._q:
+                    p = self._q.popleft()
+                    if not p.future.done():
+                        self.metrics.requests_failed.inc()
+                        p.future.set_exception(
+                            RuntimeError("scheduler thread died")
+                        )
+
+    def _loop(self) -> None:  # pragma: no cover — abstract
+        raise NotImplementedError
+
+    def _expire(self, p: _Pending, now: float) -> None:
+        self.metrics.requests_expired.inc()
+        p.future.set_exception(
+            DeadlineExceededError(
+                "deadline exceeded while queued "
+                f"({(now - p.t_enqueue) * 1e3:.0f}ms)"
+            )
+        )
+
+
+class MicroBatcher(_BatcherBase):
+    """Shape-ladder batch-at-a-time scheduler (the continuous loop's
+    fallback): coalesce, pad to the ladder, decode to completion."""
+
+    _thread_name = "caption-batcher"
+
+    def __init__(
+        self,
+        engine: InferenceEngine,
+        metrics: Optional[ServingMetrics] = None,
+        *,
+        max_batch_size: Optional[int] = None,
+        max_wait_ms: Optional[float] = None,
+        queue_depth: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
+        retry_after_s: Optional[float] = None,
+        drain_timeout_s: Optional[float] = None,
+    ):
+        super().__init__(
+            engine,
+            metrics,
+            queue_depth=queue_depth,
+            default_deadline_ms=default_deadline_ms,
+            retry_after_s=retry_after_s,
+            drain_timeout_s=drain_timeout_s,
+        )
+        sv = engine.cfg.serving
+        self.max_batch = int(max_batch_size or engine.max_batch)
+        self.max_wait_s = (
+            max_wait_ms if max_wait_ms is not None else sv.max_wait_ms
+        ) / 1e3
+
     def _loop(self) -> None:
         while True:
             batch = self._collect()
@@ -207,22 +319,24 @@ class MicroBatcher:
     def _collect(self) -> Optional[List[_Pending]]:
         """Block for the first request, then coalesce until the batch is
         full or ``max_wait_ms`` has passed since that first arrival.
-        Returns None on stop."""
+        While draining, dispatch immediately (no coalescing window) and
+        exit once the queue is empty.  Returns None on exit."""
         with self._cond:
             while not self._q and not self._stop:
                 self._cond.wait(timeout=0.1)
-            if self._stop:
+            if self._stop and (not self._q or not self._drain):
                 return None
-            t_first = self._q[0].t_enqueue
-            deadline = t_first + self.max_wait_s
-            while (
-                len(self._q) < self.max_batch
-                and not self._stop
-            ):
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break
-                self._cond.wait(timeout=remaining)
+            if not self._stop:
+                t_first = self._q[0].t_enqueue
+                deadline = t_first + self.max_wait_s
+                while (
+                    len(self._q) < self.max_batch
+                    and not self._stop
+                ):
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        break
+                    self._cond.wait(timeout=remaining)
             batch = []
             while self._q and len(batch) < self.max_batch:
                 batch.append(self._q.popleft())
@@ -233,13 +347,7 @@ class MicroBatcher:
         live: List[_Pending] = []
         for p in batch:
             if now > p.deadline:
-                self.metrics.requests_expired.inc()
-                p.future.set_exception(
-                    DeadlineExceededError(
-                        "deadline exceeded while queued "
-                        f"({(now - p.t_enqueue) * 1e3:.0f}ms)"
-                    )
-                )
+                self._expire(p, now)
             else:
                 live.append(p)
                 self.metrics.observe_stage(
@@ -279,3 +387,138 @@ class MicroBatcher:
                         batch_size=n,
                     ),
                 })
+
+
+class ContinuousBatcher(_BatcherBase):
+    """Continuous in-flight batching scheduler: the admission queue
+    feeds the engine's persistent slot loop (serving/slots.py).  Each
+    scheduler iteration admits pending requests into free slots, runs
+    ONE jitted decode block over all slots, and harvests every slot
+    whose caption finished — so short captions exit in ~their own
+    length of steps and arrivals start decoding at the next step
+    boundary."""
+
+    _thread_name = "caption-slots"
+
+    def _loop(self) -> None:
+        decoder = self.engine.slot_decoder()
+        self.metrics.slots_total.set(decoder.S)
+        drain_deadline: Optional[float] = None
+        admit_max = min(decoder.admit_cap, decoder.S)
+        while True:
+            admits: List[_Pending] = []
+            with self._cond:
+                while (
+                    not self._q
+                    and not decoder.occupied
+                    and not self._stop
+                ):
+                    self._cond.wait(timeout=0.1)
+                if self._stop:
+                    if not self._drain:
+                        break
+                    if not self._q and not decoder.occupied:
+                        return
+                    if drain_deadline is None:
+                        drain_deadline = (
+                            time.monotonic() + self.drain_timeout_s
+                        )
+                cap = min(len(decoder.free), admit_max)
+                while self._q and len(admits) < cap:
+                    admits.append(self._q.popleft())
+            if (
+                drain_deadline is not None
+                and time.monotonic() > drain_deadline
+            ):
+                self._abandon(decoder, admits, "drain deadline exceeded")
+                return
+
+            now = time.monotonic()
+            live = []
+            for p in admits:
+                if now > p.deadline:
+                    self._expire(p, now)
+                else:
+                    live.append(p)
+            # One compiled call per iteration: batched admission scatter
+            # (padded-bucket encode) fused with the decode-step block.
+            try:
+                done = decoder.tick([p.prepared for p in live], live)
+            except Exception as e:  # noqa: BLE001
+                # An admission encode can fail on a bad row — fail those
+                # submitters and keep serving.  A failure with nothing
+                # to admit is the step itself dying: fatal.
+                self.metrics.requests_failed.inc(len(live))
+                for p in live:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+                if not live:
+                    self._abandon(decoder, [], "scheduler step failed")
+                    raise
+                continue
+            t_admit = time.monotonic()
+            for p in live:
+                p.t_admit = t_admit
+                self.metrics.observe_stage(
+                    "admission", (t_admit - p.t_enqueue) * 1e3
+                )
+            if live:
+                self.metrics.slots_admitted_total.inc(len(live))
+            if decoder.occupied or live:
+                self.metrics.slot_steps_total.inc(decoder.block)
+            self.metrics.slots_occupied.set(decoder.n_occupied)
+            if done:
+                self._resolve(decoder.harvest_many(done))
+                self.metrics.slots_occupied.set(decoder.n_occupied)
+
+        # Hard stop (drain=False): fail whatever is still in flight;
+        # queued requests are failed by stop() after the join.
+        self._abandon(decoder, [], "batcher stopped")
+
+    def _resolve(self, harvested) -> None:
+        """Detokenize + cache + resolve futures for one harvest batch."""
+        t0 = time.monotonic()
+        for p, tokens, score, steps in harvested:
+            self.metrics.steps_per_caption.observe(steps)
+            self.metrics.observe_stage("device", (t0 - p.t_admit) * 1e3)
+            try:
+                res = self.engine.result_from_tokens(
+                    p.prepared,
+                    tokens,
+                    {
+                        "admission_ms": (p.t_admit - p.t_enqueue) * 1e3,
+                        "device_ms": (t0 - p.t_admit) * 1e3,
+                    },
+                )
+            except Exception as e:  # noqa: BLE001
+                self.metrics.requests_failed.inc()
+                if not p.future.done():
+                    p.future.set_exception(e)
+                continue
+            t1 = time.monotonic()
+            self.metrics.observe_stage("detok", (t1 - t0) * 1e3)
+            self.metrics.requests_served.inc()
+            if not p.future.done():
+                p.future.set_result({
+                    "caption": res.caption,
+                    "tokens": res.tokens,
+                    "cached": False,
+                    "score": score,
+                    "timings_ms": dict(
+                        res.timings_ms,
+                        detok_ms=(t1 - t0) * 1e3,
+                        decode_steps=steps,
+                    ),
+                })
+
+    def _abandon(self, decoder, admits: List[_Pending], why: str) -> None:
+        for p in admits:
+            if not p.future.done():
+                self.metrics.requests_failed.inc()
+                p.future.set_exception(RuntimeError(why))
+        for slot in list(decoder.occupied):
+            p = decoder.evict(slot)
+            if p is not None and not p.future.done():
+                self.metrics.requests_failed.inc()
+                p.future.set_exception(RuntimeError(why))
+        self.metrics.slots_occupied.set(0)

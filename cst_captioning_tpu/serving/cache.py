@@ -7,13 +7,19 @@ client-supplied ``feature_id`` to the request's preprocessed feature
 rows AND (after the first decode) the projected encoder state
 (:class:`~cst_captioning_tpu.models.captioner.DecodeCache` rows), so a
 repeat request that only names the id skips both the feature upload and
-the encoder projections (``decoding.beam.beam_search_from_state``).
+the encoder projections (``decoding.beam.beam_search_from_state`` /
+the continuous slot loop's admission encode).
 
 Both tiers are plain LRU over an ``OrderedDict`` under one lock per
-tier — the working set is bounded by config
-(``ServingConfig.caption_cache_size`` / ``feature_cache_size``) and the
-values are host numpy, never device arrays, so eviction frees real
-memory immediately.
+tier.  The working set is bounded two ways: by entry count
+(``ServingConfig.caption_cache_size`` / ``feature_cache_size``) and —
+for tier 2, whose values are multi-KB projected encoder rows, not
+strings — by BYTES (``feature_cache_bytes``): every ``put`` sizes the
+entry's numpy payload and evicts least-recently-used entries until the
+tier fits the budget.  Evictions are counted and exported on
+``/metrics`` so an undersized budget is visible, not silent.  Values
+are host numpy, never device arrays, so eviction frees real memory
+immediately.
 """
 
 from __future__ import annotations
@@ -26,17 +32,42 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 
-class LRUCache:
-    """Thread-safe LRU mapping with hit/miss counters."""
+def entry_nbytes(value: Any) -> int:
+    """Approximate host bytes held by a cache value: numpy arrays count
+    their buffers, containers recurse, everything else a flat 64-byte
+    floor (keys/str/ints — negligible next to feature rows)."""
+    if isinstance(value, np.ndarray):
+        return int(value.nbytes)
+    if isinstance(value, dict):
+        return sum(entry_nbytes(v) for v in value.values()) + 64
+    if isinstance(value, (list, tuple)):
+        return sum(entry_nbytes(v) for v in value) + 64
+    return 64
 
-    def __init__(self, capacity: int):
+
+class LRUCache:
+    """Thread-safe LRU mapping with hit/miss/eviction counters.
+
+    ``capacity`` bounds entries; ``max_bytes`` (0 = unbounded)
+    additionally bounds the summed :func:`entry_nbytes` of the values —
+    the binding constraint for tier 2, where one projected-state entry
+    can outweigh thousands of caption strings.
+    """
+
+    def __init__(self, capacity: int, max_bytes: int = 0):
         if capacity < 0:
             raise ValueError(f"capacity {capacity} < 0")
+        if max_bytes < 0:
+            raise ValueError(f"max_bytes {max_bytes} < 0")
         self.capacity = capacity
+        self.max_bytes = max_bytes
         self._d: "OrderedDict[Any, Any]" = OrderedDict()
+        self._sizes: Dict[Any, int] = {}
+        self._bytes = 0
         self._lock = threading.Lock()
         self._hits = 0
         self._misses = 0
+        self._evictions = 0
 
     def get(self, key) -> Optional[Any]:
         with self._lock:
@@ -50,12 +81,24 @@ class LRUCache:
     def put(self, key, value) -> None:
         if self.capacity == 0:
             return
+        size = entry_nbytes(value) if self.max_bytes else 0
         with self._lock:
             if key in self._d:
                 self._d.move_to_end(key)
+                self._bytes -= self._sizes.get(key, 0)
             self._d[key] = value
-            while len(self._d) > self.capacity:
-                self._d.popitem(last=False)
+            self._sizes[key] = size
+            self._bytes += size
+            # Evict LRU-first until both bounds hold.  A single entry
+            # bigger than the whole byte budget evicts itself — the
+            # tier never holds more than max_bytes.
+            while self._d and (
+                len(self._d) > self.capacity
+                or (self.max_bytes and self._bytes > self.max_bytes)
+            ):
+                k, _ = self._d.popitem(last=False)
+                self._bytes -= self._sizes.pop(k, 0)
+                self._evictions += 1
 
     def __len__(self) -> int:
         with self._lock:
@@ -69,16 +112,22 @@ class LRUCache:
     def clear(self) -> None:
         with self._lock:
             self._d.clear()
+            self._sizes.clear()
+            self._bytes = 0
 
     def stats(self) -> Dict[str, float]:
         with self._lock:
             hits, misses, size = self._hits, self._misses, len(self._d)
+            evictions, nbytes = self._evictions, self._bytes
         total = hits + misses
         return {
             "hits": hits,
             "misses": misses,
             "size": size,
             "capacity": self.capacity,
+            "bytes": nbytes,
+            "max_bytes": self.max_bytes,
+            "evictions": evictions,
             "hit_rate": round(hits / total, 4) if total else 0.0,
         }
 
@@ -101,11 +150,18 @@ def content_key(
 
 
 class TwoTierCache:
-    """``captions`` (tier 1) + ``features`` (tier 2); see module doc."""
+    """``captions`` (tier 1) + ``features`` (tier 2); see module doc.
+    ``feature_max_bytes`` byte-bounds tier 2 only — tier-1 values are
+    short strings, the entry count is the honest bound there."""
 
-    def __init__(self, caption_capacity: int, feature_capacity: int):
+    def __init__(
+        self,
+        caption_capacity: int,
+        feature_capacity: int,
+        feature_max_bytes: int = 0,
+    ):
         self.captions = LRUCache(caption_capacity)
-        self.features = LRUCache(feature_capacity)
+        self.features = LRUCache(feature_capacity, feature_max_bytes)
 
     def stats(self) -> Dict[str, Dict[str, float]]:
         return {

@@ -130,7 +130,9 @@ class InferenceEngine:
             )
         self.ladder = ladder
         self.cache = cache or TwoTierCache(
-            sv.caption_cache_size, sv.feature_cache_size
+            sv.caption_cache_size,
+            sv.feature_cache_size,
+            sv.feature_cache_bytes,
         )
         # Everything that changes decoded tokens goes into the tier-1
         # key tag, so a reconfigured/reloaded engine can never serve a
@@ -144,6 +146,7 @@ class InferenceEngine:
         self._encode_fns: Dict[int, Any] = {}
         self._state_fns: Dict[int, Any] = {}
         self._fused_at: Dict[int, bool] = {}
+        self._slot_decoder = None
         if sv.warmup:
             self.warmup()
 
@@ -372,35 +375,20 @@ class InferenceEngine:
         return self._fused_at[B]
 
     def warmup(self) -> None:
-        """Pre-jit the whole ladder so the first real request never pays
-        XLA compile latency."""
-        d = self.cfg.data
+        """Pre-jit the whole ladder — and, when continuous mode is
+        configured, the slot loop's step/admit/extract fns — so the
+        first real request never pays XLA compile latency."""
         t0 = time.perf_counter()
         for B in self.ladder:
-            rows = [
-                PreparedRequest(
-                    feats={
-                        m: np.zeros((d.max_frames, d.feature_dims[m]),
-                                    np.float32)
-                        for m in d.feature_modalities
-                    },
-                    masks={
-                        m: np.concatenate(
-                            [np.ones((1,), np.float32),
-                             np.zeros((d.max_frames - 1,), np.float32)]
-                        )
-                        for m in d.feature_modalities
-                    },
-                    category=0,
-                    feature_id=None,
-                    cache_key="",
-                    enc_row=None,
-                )
-            ] * B
+            rows = [self.template_prepared()] * B
             self.decode_prepared(rows, store=False)
+        if self.cfg.serving.continuous:
+            self.slot_decoder().warmup()
         _log.info(
-            "serving engine warm: ladder %s compiled in %.1fs",
-            self.ladder, time.perf_counter() - t0,
+            "serving engine warm: ladder %s%s compiled in %.1fs",
+            self.ladder,
+            " + slot loop" if self.cfg.serving.continuous else "",
+            time.perf_counter() - t0,
         )
 
     # --------------------------------------------------------------- decode
@@ -520,6 +508,95 @@ class InferenceEngine:
                 entry["enc"] = enc
                 self.cache.features.put(r.feature_id, entry)
 
+    # ------------------------------------------- continuous-mode helpers
+    def encode_prepared_rows(
+        self, reqs: Sequence[PreparedRequest]
+    ) -> DecodeCache:
+        """The slot loop's admission encode: (B, ...) projected encoder
+        rows for one admission batch, B = len(reqs) (the loop pads the
+        batch to a compiled bucket itself).  When EVERY request carries
+        tier-2 rows the encode is skipped outright (host stack +
+        upload); otherwise ONE jitted ``init_decode`` — the same encode
+        the offline paths run — covers the whole batch, and rows are
+        stored back into tier 2 for requests with a ``feature_id``."""
+        if all(r.enc_row is not None for r in reqs):
+            return DecodeCache(*(
+                jnp.asarray(np.stack([np.asarray(r.enc_row[f]) for r in reqs]))
+                for f in range(len(reqs[0].enc_row))
+            ))
+        feats = {
+            m: jnp.asarray(np.stack([r.feats[m] for r in reqs]))
+            for m in self.cfg.data.feature_modalities
+        }
+        masks = {
+            m: jnp.asarray(np.stack([r.masks[m] for r in reqs]))
+            for m in self.cfg.data.feature_modalities
+        }
+        cat = (
+            jnp.asarray(
+                np.asarray([r.category for r in reqs], np.int32)
+            )
+            if self.model.use_category
+            else None
+        )
+        cache = self._encode_fn(len(reqs))(self.params, feats, masks, cat)
+        self._store_enc_rows(reqs, cache)
+        return cache
+
+    def template_prepared(self) -> PreparedRequest:
+        """A valid all-zeros request row (warmup traffic)."""
+        d = self.cfg.data
+        return PreparedRequest(
+            feats={
+                m: np.zeros((d.max_frames, d.feature_dims[m]), np.float32)
+                for m in d.feature_modalities
+            },
+            masks={
+                m: np.concatenate(
+                    [np.ones((1,), np.float32),
+                     np.zeros((d.max_frames - 1,), np.float32)]
+                )
+                for m in d.feature_modalities
+            },
+            category=0,
+            feature_id=None,
+            cache_key="",
+            enc_row=None,
+        )
+
+    def result_from_tokens(
+        self,
+        req: PreparedRequest,
+        tokens: np.ndarray,
+        timings_ms: Dict[str, float],
+        store: bool = True,
+    ) -> DecodedResult:
+        """Detokenize one decoded row and store it in tier 1 — the
+        per-caption tail of ``decode_prepared``, shared with the slot
+        loop's harvest path."""
+        caption = decode_sequence(self.vocab, tokens[None])[0]
+        res = DecodedResult(
+            caption=caption,
+            tokens=[int(t) for t in tokens],
+            timings_ms=timings_ms,
+        )
+        if store and req.cache_key:
+            self.cache.captions.put(
+                req.cache_key,
+                {"caption": res.caption, "tokens": res.tokens},
+            )
+        return res
+
+    def slot_decoder(self):
+        """The engine's persistent :class:`~cst_captioning_tpu.serving.
+        slots.SlotDecoder` (continuous in-flight batching), built lazily
+        — one slot matrix and one set of compiled slot fns per engine."""
+        if self._slot_decoder is None:
+            from cst_captioning_tpu.serving.slots import SlotDecoder
+
+            self._slot_decoder = SlotDecoder(self)
+        return self._slot_decoder
+
     # ----------------------------------------------------------- info
     def describe(self) -> Dict[str, Any]:
         return {
@@ -528,6 +605,10 @@ class InferenceEngine:
             "beam_size": self.cfg.eval.beam_size,
             "max_decode_len": self.cfg.eval.max_decode_len,
             "batch_ladder": self.ladder,
+            "continuous": bool(self.cfg.serving.continuous),
+            "num_slots": int(
+                self.cfg.serving.num_slots or self.max_batch
+            ),
             "modalities": {
                 m: self.cfg.data.feature_dims[m]
                 for m in self.cfg.data.feature_modalities

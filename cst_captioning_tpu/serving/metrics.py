@@ -22,11 +22,21 @@ DEFAULT_BUCKETS_MS: List[float] = [
 ]
 
 # The serving pipeline's stage names, in request order.  ``queue`` is
-# enqueue -> batch pop (scheduler wait), ``pad`` is batch assembly +
-# shape-bucket padding, ``device`` is the jitted decode (including the
-# H2D/D2H transfers it blocks on), ``detok`` is tokens -> text, and
-# ``total`` is submit -> response.
-STAGES = ("queue", "pad", "device", "detok", "total")
+# enqueue -> batch pop (scheduler wait), ``admission`` is enqueue ->
+# decode-slot admission (continuous mode — the in-flight analogue of
+# ``queue``), ``pad`` is batch assembly + shape-bucket padding,
+# ``device`` is the jitted decode (including the H2D/D2H transfers it
+# blocks on), ``detok`` is tokens -> text, and ``total`` is submit ->
+# response.
+STAGES = ("queue", "admission", "pad", "device", "detok", "total")
+
+# Bucket upper bounds for the steps-per-caption histogram (decode steps
+# a caption actually paid before its slot freed — the continuous-mode
+# win is this collapsing toward caption length instead of max_len).
+STEP_BUCKETS = [
+    1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 10.0, 12.0, 16.0, 20.0, 24.0,
+    28.0, 32.0, 48.0, 64.0,
+]
 
 
 class Counter:
@@ -42,6 +52,23 @@ class Counter:
 
     @property
     def value(self) -> int:
+        with self._lock:
+            return self._v
+
+
+class Gauge:
+    """Thread-safe last-value gauge (slot occupancy, queue depth)."""
+
+    def __init__(self) -> None:
+        self._v = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._v = float(v)
+
+    @property
+    def value(self) -> float:
         with self._lock:
             return self._v
 
@@ -138,6 +165,13 @@ class ServingMetrics:
         self.batches_total = Counter()
         self.batch_rows_total = Counter()   # live rows across batches
         self.batch_pad_rows_total = Counter()  # padding rows (waste)
+        # Continuous-mode (slot loop) observability:
+        self.slots_total = Gauge()          # configured decode slots S
+        self.slots_occupied = Gauge()       # live slots right now
+        self.slots_admitted_total = Counter()   # admissions into slots
+        self.slot_steps_total = Counter()   # device decode steps run
+        # Decode steps each caption actually paid before its slot freed.
+        self.steps_per_caption = LatencyHistogram(STEP_BUCKETS)
 
     # ------------------------------------------------------------- views
     def observe_stage(self, stage: str, ms: float) -> None:
@@ -161,6 +195,13 @@ class ServingMetrics:
                 "mean_size": round(self.mean_batch_size(), 3),
                 "pad_rows": self.batch_pad_rows_total.value,
             },
+            "slots": {
+                "total": self.slots_total.value,
+                "occupied": self.slots_occupied.value,
+                "admitted": self.slots_admitted_total.value,
+                "device_steps": self.slot_steps_total.value,
+                "steps_per_caption": self.steps_per_caption.snapshot(),
+            },
             "latency_ms": {s: h.snapshot() for s, h in self.stages.items()},
         }
         if cache_stats is not None:
@@ -180,12 +221,26 @@ class ServingMetrics:
             "caption_batches_total": self.batches_total,
             "caption_batch_rows_total": self.batch_rows_total,
             "caption_batch_pad_rows_total": self.batch_pad_rows_total,
+            "caption_slots_admitted_total": self.slots_admitted_total,
+            "caption_slot_device_steps_total": self.slot_steps_total,
         }
         for name, c in counters.items():
             lines.append(f"# TYPE {name} counter")
             lines.append(f"{name} {c.value}")
-        for stage, h in self.stages.items():
-            name = f"caption_latency_{stage}_ms"
+        for name, g in (
+            ("caption_slots_total", self.slots_total),
+            ("caption_slots_occupied", self.slots_occupied),
+        ):
+            lines.append(f"# TYPE {name} gauge")
+            lines.append(f"{name} {g.value}")
+        hists = dict(
+            {
+                f"caption_latency_{s}_ms": h
+                for s, h in self.stages.items()
+            },
+            caption_steps_per_caption=self.steps_per_caption,
+        )
+        for name, h in hists.items():
             lines.append(f"# TYPE {name} histogram")
             cum = 0
             counts = h.bucket_counts()
@@ -201,7 +256,10 @@ class ServingMetrics:
             )
         if cache_stats:
             for tier, st in cache_stats.items():
-                for k in ("hits", "misses", "size", "capacity"):
+                for k in (
+                    "hits", "misses", "size", "capacity", "bytes",
+                    "evictions",
+                ):
                     if k in st:
                         lines.append(
                             f"caption_cache_{tier}_{k} {st[k]}"

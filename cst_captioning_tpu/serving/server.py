@@ -6,30 +6,42 @@ Endpoints:
   "feature_id": str?, "category": int?, "deadline_ms": float?}`` ->
   ``{"caption", "tokens", "cached", "timings_ms"}``.  Errors: 400 (bad
   input), 404 (unknown ``feature_id`` with no features), 429 (queue
-  full; ``Retry-After`` header set), 504 (deadline exceeded), 500
-  (engine failure).
+  full; ``Retry-After`` header set), 503 (draining/shutdown), 504
+  (deadline exceeded), 500 (engine failure).
 * ``GET /healthz`` — liveness + engine description.
 * ``GET /metrics`` — Prometheus text exposition (per-stage latency
-  histograms, request counters, cache tiers).
+  histograms, slot occupancy, request counters, cache tiers).
 * ``GET /stats``  — the same numbers as one JSON object.
 
 ``ThreadingHTTPServer`` gives one thread per in-flight request, which
-matches :meth:`MicroBatcher.submit`'s blocking contract; the batcher's
-bounded queue — not the thread pool — is the backpressure surface.
+matches the batcher ``submit`` blocking contract; the batcher's bounded
+queue — not the thread pool — is the backpressure surface.
+
+The scheduler behind ``submit`` is picked by ``serving.continuous``:
+the slot-based continuous batcher (default) or the PR-2 shape-ladder
+micro-batcher (fallback) — see serving/batcher.py.
+
+Graceful shutdown: ``shutdown()`` (and SIGTERM under
+``serve_forever``) first closes admissions — new requests get 503 while
+the listener stays up — then drains queued + in-flight work within
+``serving.drain_timeout_s``, then tears the listener down.
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
 from cst_captioning_tpu.serving.batcher import (
     BackpressureError,
+    ContinuousBatcher,
     DeadlineExceededError,
     MicroBatcher,
+    ShuttingDownError,
 )
 from cst_captioning_tpu.serving.engine import InferenceEngine
 from cst_captioning_tpu.serving.metrics import ServingMetrics
@@ -73,8 +85,9 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
         srv = self.server
         if self.path == "/healthz":
+            status = "draining" if srv.draining else "ok"
             self._send_json(
-                200, {"status": "ok", **srv.engine.describe()}
+                200, {"status": status, **srv.engine.describe()}
             )
         elif self.path == "/metrics":
             body = srv.metrics.to_prometheus(
@@ -92,6 +105,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         if self.path != "/v1/caption":
             self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        if self.server.draining:
+            self._send_json(
+                503, {"error": "server is draining; not accepting requests"}
+            )
             return
         try:
             length = int(self.headers.get("Content-Length", "0"))
@@ -118,6 +136,8 @@ class _Handler(BaseHTTPRequestHandler):
                 {"error": str(e), "retry_after_s": e.retry_after_s},
                 headers={"Retry-After": f"{e.retry_after_s:.3f}"},
             )
+        except ShuttingDownError as e:
+            self._send_json(503, {"error": str(e)})
         except DeadlineExceededError as e:
             self._send_json(504, {"error": str(e)})
         except KeyError as e:
@@ -132,14 +152,16 @@ class _Handler(BaseHTTPRequestHandler):
 class _Server(ThreadingHTTPServer):
     daemon_threads = True
     engine: InferenceEngine
-    batcher: MicroBatcher
+    batcher: Any
     metrics: ServingMetrics
+    draining: bool = False
 
 
 class CaptionServer:
-    """Engine + batcher + HTTP listener, wired.  ``port=0`` binds an
-    ephemeral port (tests); ``serve_forever`` blocks, or use the
-    context manager / ``start``+``shutdown`` for in-process use."""
+    """Engine + scheduler + HTTP listener, wired.  ``port=0`` binds an
+    ephemeral port (tests); ``serve_forever`` blocks (and installs a
+    SIGTERM -> graceful-shutdown handler), or use the context manager /
+    ``start``+``shutdown`` for in-process use."""
 
     def __init__(
         self,
@@ -147,12 +169,15 @@ class CaptionServer:
         host: Optional[str] = None,
         port: Optional[int] = None,
         metrics: Optional[ServingMetrics] = None,
-        batcher: Optional[MicroBatcher] = None,
+        batcher: Optional[Any] = None,
     ):
         sv = engine.cfg.serving
         self.engine = engine
         self.metrics = metrics or ServingMetrics()
-        self.batcher = batcher or MicroBatcher(engine, self.metrics)
+        if batcher is None:
+            cls = ContinuousBatcher if sv.continuous else MicroBatcher
+            batcher = cls(engine, self.metrics)
+        self.batcher = batcher
         self._http = _Server(
             (host if host is not None else sv.host,
              port if port is not None else sv.port),
@@ -162,6 +187,8 @@ class CaptionServer:
         self._http.batcher = self.batcher
         self._http.metrics = self.metrics
         self._thread: Optional[threading.Thread] = None
+        self._shutdown_lock = threading.Lock()
+        self._closed = False
 
     @property
     def address(self) -> Tuple[str, int]:
@@ -180,24 +207,58 @@ class CaptionServer:
             daemon=True,
         )
         self._thread.start()
-        _log.info("caption server listening on %s", self.url)
+        _log.info(
+            "caption server listening on %s (%s scheduler)",
+            self.url, type(self.batcher).__name__,
+        )
         return self
 
     def serve_forever(self) -> None:
         self.batcher.start()
-        _log.info("caption server listening on %s", self.url)
+        _log.info(
+            "caption server listening on %s (%s scheduler)",
+            self.url, type(self.batcher).__name__,
+        )
+        try:
+            # SIGTERM -> graceful drain.  shutdown() must not run on the
+            # thread blocked in serve_forever (it would deadlock waiting
+            # for the poll loop), so the handler hands it to a thread.
+            signal.signal(
+                signal.SIGTERM,
+                lambda *_: threading.Thread(
+                    target=self.shutdown, name="caption-sigterm",
+                    daemon=True,
+                ).start(),
+            )
+        except ValueError:
+            pass  # not the main thread — no signal handling
         try:
             self._http.serve_forever()
         finally:
-            self.batcher.stop()
+            self.shutdown()
 
-    def shutdown(self) -> None:
+    def begin_drain(self) -> None:
+        """Close admissions: new HTTP requests get 503, the batcher
+        rejects new submits; in-flight work keeps running."""
+        self._http.draining = True
+        self.batcher.begin_drain()
+
+    def shutdown(self, drain: bool = True) -> None:
+        """Graceful stop: 503 new requests, drain queued + in-flight
+        work to completion within ``serving.drain_timeout_s``, then tear
+        the listener down.  ``drain=False`` skips the drain (queued
+        requests fail fast)."""
+        with self._shutdown_lock:
+            if self._closed:
+                return
+            self._closed = True
+        self.begin_drain()
+        self.batcher.stop(drain=drain)
         self._http.shutdown()
         self._http.server_close()
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
-        self.batcher.stop()
 
     def __enter__(self) -> "CaptionServer":
         return self.start()

@@ -1,0 +1,477 @@
+"""Continuous in-flight batching: the persistent slot-based decode loop.
+
+The PR-2 engine decodes batch-at-a-time: a coalesced micro-batch runs
+``max_decode_len`` scan steps to completion while newly arrived requests
+wait for the whole batch to drain.  MSR-VTT captions average ~9-12
+tokens against a 28-30 cap, so most of that work is PAD-frozen rows and
+most of the wait is head-of-line blocking.  This module holds the
+standard production fix (continuous batching at decode-step
+granularity):
+
+* a fixed matrix of ``S`` decode slots — greedy: 1 row/slot, beam: K
+  contiguous rows/slot — whose per-slot state (``DecodeState`` rows,
+  projected ``DecodeCache`` rows, emitted tokens, beam scores, finished
+  flags, step counter) lives on device as one pytree of static shapes;
+* each scheduler iteration (:meth:`SlotDecoder.tick`) is ONE jitted
+  call: admit up to ``admit_cap`` pending requests into free slots via
+  ``jax.lax.dynamic_update_slice`` on every leaf of the state pytree,
+  then run ``slot_block_steps`` decode steps over all ``S*K`` rows —
+  so a new request starts decoding at the next STEP boundary instead
+  of the next batch boundary;
+* slots whose rows all hit EOS — or the length cap — are harvested
+  (host-side, from the tick's own outputs — no extra device call) and
+  freed, so a short caption exits in ~its-own-length steps.
+
+Host-overhead discipline: with short captions, admissions and harvests
+happen roughly once per caption, so per-request device dispatches would
+dominate the step loop.  The loop therefore pays a CONSTANT number of
+dispatches per iteration: admission is batched (one padded-bucket
+encode, scatter fused into the step call, one compiled variant per
+admission-count bucket) and harvest reads the (tiny) token/score
+matrices the tick already returned.
+
+Parity argument (the bar: slot-decoded captions are token-exact vs the
+offline ``evaluation.py`` path, pinned by tests/test_serving.py):
+
+* The per-step math is lifted verbatim from ``decoding/beam.py``
+  (beam) / ``CaptionModel._sample_from_cache`` (greedy): same
+  ``decode_one`` apply, same PAD-freeze of finished beams, same
+  ``lax.top_k`` / argmax selection, same parent gather — only the batch
+  axis is the slot axis and the sequence-write position is the per-slot
+  step counter instead of the shared scan index.  Every op is
+  row-independent, so which OTHER requests share the matrix (or arrive
+  later — admission order) cannot change any row's numbers.
+* A finished slot that keeps riding (until harvest, or the remainder of
+  a step block) is frozen exactly like the offline scan's finished
+  beams: its only continuation is PAD at zero cost, a no-op on
+  (tokens, scores).
+* The admission encode is the same jitted ``init_decode`` the offline
+  paths run, at a padded shape-ladder bucket (row-independent padding,
+  the PR-2 discipline); admission-batch padding rows re-write the last
+  real slot's rows — idempotent by construction.
+* The host epilogue mirrors :func:`decoding.beam.finalize_beams` in
+  numpy with a stable argsort — the same tie behavior as the offline
+  jnp epilogue.
+
+Threading: a ``SlotDecoder`` is owned by exactly one scheduler thread
+(``serving.batcher.ContinuousBatcher``); nothing here locks.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.beam import NEG_INF
+from cst_captioning_tpu.models.captioner import (
+    DecodeCache,
+    DecodeState,
+)
+
+_log = logging.getLogger("cst_captioning_tpu.serving")
+
+
+def _buckets(top: int) -> List[int]:
+    out, b = [], 1
+    while b < top:
+        out.append(b)
+        b *= 2
+    out.append(top)
+    return out
+
+
+class SlotState(NamedTuple):
+    """Device-resident state of all S decode slots (flat row axis is
+    ``S*K``; per-slot axes are ``(S, K, ...)``)."""
+
+    h: jax.Array          # (layers, S*K, H) compute dtype
+    c: jax.Array          # (layers, S*K, H) float32
+    cache: DecodeCache    # leaves lead with S*K
+    seqs: jax.Array       # (S, K, L) int32 emitted tokens
+    scores: jax.Array     # (S, K) float32 beam log-probs
+    finished: jax.Array   # (S, K) bool
+    tokens: jax.Array     # (S*K,) int32 next-step input tokens
+    step: jax.Array       # (S,) int32 decode step per slot (clamped at L)
+
+
+class SlotDecoder:
+    """See module doc.  Built by ``InferenceEngine.slot_decoder()``."""
+
+    def __init__(self, engine):
+        self.engine = engine
+        cfg = engine.cfg
+        sv = cfg.serving
+        self.greedy = engine.decode_mode == "greedy"
+        self.K = 1 if self.greedy else cfg.eval.beam_size
+        self.L = cfg.eval.max_decode_len
+        self.S = int(sv.num_slots or engine.max_batch)
+        if self.S < 1:
+            raise ValueError(f"num_slots {self.S} < 1")
+        self.block = max(1, int(sv.slot_block_steps))
+        self.length_normalize = cfg.eval.length_normalize
+        self.model = engine.model
+        self.V = self.model.vocab_size
+        # Admissions per tick are capped so the padded admission-encode
+        # bucket stays within the engine's compiled shape discipline.
+        self.admit_cap = min(self.S, engine.max_batch)
+        self._admit_buckets = _buckets(self.admit_cap)
+        if getattr(self.model, "use_pallas_beam", False):
+            # The fused whole-recurrence kernel decodes run-to-completion
+            # by construction; the slot loop needs step granularity.
+            _log.info(
+                "continuous slot loop uses the per-step scan math; the "
+                "fused beam kernel (use_pallas_beam) applies to the "
+                "ladder/offline paths only"
+            )
+        # Host-side slot bookkeeping (scheduler thread only).
+        self.free: List[int] = list(range(self.S))
+        self.occupied: Dict[int, Any] = {}      # slot -> caller's data
+        self.steps_paid: Dict[int, int] = {}    # slot -> device steps
+        self._tick_fns: Dict[int, Any] = {}
+        # Post-tick snapshots consumed by harvest_many (device arrays;
+        # fetched lazily, at most once per tick).
+        self._seqs_d = None
+        self._scores_d = None
+        self._seqs_np: Optional[np.ndarray] = None
+        self._scores_np: Optional[np.ndarray] = None
+        self._build_step()
+        self._st = self._init_state()
+
+    # ------------------------------------------------------------- device
+    def _init_state(self) -> SlotState:
+        model, S, K, L = self.model, self.S, self.K, self.L
+        cdt = jnp.dtype(model.compute_dtype)
+        n = S * K
+        d = self.engine.cfg.data
+        # Zero cache rows shaped exactly like one encode output: let
+        # eval_shape infer the (S*K, ...) DecodeCache leaf shapes.
+        feats = {
+            m: jnp.zeros((n, d.max_frames, d.feature_dims[m]))
+            for m in d.feature_modalities
+        }
+        masks = {m: jnp.ones((n, d.max_frames)) for m in feats}
+        cat = (
+            jnp.zeros((n,), jnp.int32) if model.use_category else None
+        )
+        cache_shape = jax.eval_shape(
+            lambda f, mk, c: model.apply(
+                self.engine.params, f, mk, c, method="init_decode"
+            )[1],
+            feats, masks, cat,
+        )
+        cache = jax.tree.map(
+            lambda sds: jnp.zeros(sds.shape, sds.dtype), cache_shape
+        )
+        return SlotState(
+            h=jnp.zeros((model.num_layers, n, model.rnn_size), cdt),
+            c=jnp.zeros((model.num_layers, n, model.rnn_size), jnp.float32),
+            cache=cache,
+            seqs=jnp.full((S, K, L), PAD_ID, jnp.int32),
+            scores=jnp.zeros((S, K), jnp.float32),
+            # Empty slots ride as finished/step=L: done, frozen, harmless.
+            finished=jnp.ones((S, K), bool),
+            tokens=jnp.full((n,), BOS_ID, jnp.int32),
+            step=jnp.full((S,), L, jnp.int32),
+        )
+
+    def _build_step(self) -> None:
+        model, S, K, L, V = self.model, self.S, self.K, self.L, self.V
+        greedy = self.greedy
+
+        def step_once(params, st: SlotState) -> SlotState:
+            state = DecodeState(h=st.h, c=st.c)
+            state, logp = model.apply(
+                params, state, st.cache, st.tokens, method="decode_one"
+            )  # logp: (S*K, V) float32
+            write = (
+                jnp.arange(L)[None, :] == st.step[:, None]
+            )  # (S, L); all-False once step >= L
+            if greedy:
+                # CaptionModel._sample_from_cache greedy scan body,
+                # slot-indexed write position.
+                nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)  # (S,)
+                valid = ~st.finished[:, 0]
+                out_tok = jnp.where(valid, nxt, PAD_ID)
+                seqs = jnp.where(
+                    write[:, None, :], out_tok[:, None, None], st.seqs
+                )
+                finished = st.finished | (
+                    (nxt == EOS_ID) | (nxt == PAD_ID)
+                )[:, None]
+                feed = jnp.where(out_tok == PAD_ID, EOS_ID, out_tok)
+                return st._replace(
+                    h=state.h, c=state.c, seqs=seqs, finished=finished,
+                    tokens=feed,
+                    step=jnp.minimum(st.step + 1, L),
+                )
+            # decoding/beam.py::beam_search_from_state scan body,
+            # slot-indexed write position.
+            logp = logp.reshape(S, K, V)
+            pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
+            logp = jnp.where(
+                st.finished[..., None], pad_only[None, None, :], logp
+            )
+            total = st.scores[..., None] + logp               # (S, K, V)
+            top_scores, top_flat = jax.lax.top_k(
+                total.reshape(S, K * V), K
+            )
+            parent = top_flat // V                             # (S, K)
+            tok = (top_flat % V).astype(jnp.int32)             # (S, K)
+            slot_ix = jnp.arange(S)[:, None]
+            seqs = st.seqs[slot_ix, parent]
+            seqs = jnp.where(write[:, None, :], tok[:, :, None], seqs)
+            finished = (
+                st.finished[slot_ix, parent]
+                | (tok == EOS_ID)
+                | (tok == PAD_ID)
+            )
+            flat_parent = (slot_ix * K + parent).reshape(-1)   # (S*K,)
+            next_tok = jnp.where(tok == PAD_ID, EOS_ID, tok).reshape(-1)
+            return SlotState(
+                h=state.h[:, flat_parent],
+                c=state.c[:, flat_parent],
+                cache=st.cache,
+                seqs=seqs,
+                scores=top_scores,
+                finished=finished,
+                tokens=next_tok,
+                step=jnp.minimum(st.step + 1, L),
+            )
+
+        self._step_once = step_once
+        self._scores0 = jnp.where(
+            jnp.arange(K) == 0, 0.0, NEG_INF
+        ).astype(jnp.float32)[None, :]                          # (1, K)
+
+    def _tick_fn(self, A: int):
+        """One compiled scheduler iteration: scatter A admissions into
+        their slots (A static per variant, 0 = pure step), then run the
+        step block.  Returns the new state plus everything the host
+        needs — done flags and the token/score matrices — so harvests
+        cost no extra device call."""
+        if A in self._tick_fns:
+            return self._tick_fns[A]
+        model, K, L = self.model, self.K, self.L
+        cdt = jnp.dtype(model.compute_dtype)
+        scores0 = self._scores0
+        step_once, block = self._step_once, self.block
+
+        def admit_one(st: SlotState, slot, rows_k: DecodeCache):
+            """Scatter one request's K beam rows into ``slot``."""
+            row0 = slot * K
+            cache = jax.tree.map(
+                lambda leaf, r: jax.lax.dynamic_update_slice(
+                    leaf, r.astype(leaf.dtype),
+                    (row0,) + (jnp.int32(0),) * (leaf.ndim - 1),
+                ),
+                st.cache, rows_k,
+            )
+            return SlotState(
+                h=jax.lax.dynamic_update_slice(
+                    st.h,
+                    jnp.zeros((model.num_layers, K, model.rnn_size), cdt),
+                    (jnp.int32(0), row0, jnp.int32(0)),
+                ),
+                c=jax.lax.dynamic_update_slice(
+                    st.c,
+                    jnp.zeros(
+                        (model.num_layers, K, model.rnn_size), jnp.float32
+                    ),
+                    (jnp.int32(0), row0, jnp.int32(0)),
+                ),
+                cache=cache,
+                seqs=jax.lax.dynamic_update_slice(
+                    st.seqs,
+                    jnp.full((1, K, L), PAD_ID, jnp.int32),
+                    (slot, jnp.int32(0), jnp.int32(0)),
+                ),
+                scores=jax.lax.dynamic_update_slice(
+                    st.scores, scores0, (slot, jnp.int32(0))
+                ),
+                finished=jax.lax.dynamic_update_slice(
+                    st.finished,
+                    jnp.zeros((1, K), bool),
+                    (slot, jnp.int32(0)),
+                ),
+                tokens=jax.lax.dynamic_update_slice(
+                    st.tokens,
+                    jnp.full((K,), BOS_ID, jnp.int32),
+                    (row0,),
+                ),
+                step=jax.lax.dynamic_update_slice(
+                    st.step, jnp.zeros((1,), jnp.int32), (slot,)
+                ),
+            )
+
+        @jax.jit
+        def tick(params, st: SlotState, slots, rows: DecodeCache):
+            if A:
+                # (A, ...) request rows -> (A*K, ...) beam rows, once.
+                rows = jax.tree.map(
+                    lambda x: jnp.repeat(x, K, axis=0), rows
+                )
+                for i in range(A):
+                    rows_k = jax.tree.map(
+                        lambda r: jax.lax.dynamic_slice(
+                            r,
+                            (i * K,) + (0,) * (r.ndim - 1),
+                            (K,) + r.shape[1:],
+                        ),
+                        rows,
+                    )
+                    st = admit_one(
+                        st, slots[i].astype(jnp.int32), rows_k
+                    )
+            for _ in range(block):
+                st = step_once(params, st)
+            done = jnp.all(st.finished, axis=-1) | (st.step >= L)
+            return st, done, st.seqs, st.scores
+
+        self._tick_fns[A] = tick
+        return tick
+
+    def _pad_bucket(self, n: int) -> int:
+        for b in self._admit_buckets:
+            if b >= n:
+                return b
+        return self._admit_buckets[-1]
+
+    # --------------------------------------------------------------- host
+    @property
+    def n_occupied(self) -> int:
+        return len(self.occupied)
+
+    def tick(
+        self,
+        prepared: Sequence[Any] = (),
+        datas: Sequence[Any] = (),
+    ) -> List[int]:
+        """One scheduler iteration: admit ``prepared`` (up to
+        ``admit_cap``; caller gates on ``free``) and run one step block
+        over all slots.  Returns the occupied slots that are now done
+        (all beams finished, or length cap)."""
+        n = len(prepared)
+        if n == 0 and not self.occupied:
+            return []
+        if n > len(self.free) or n > self.admit_cap:
+            raise RuntimeError(
+                f"tick admitting {n} exceeds free={len(self.free)} "
+                f"cap={self.admit_cap}"
+            )
+        if n:
+            A = self._pad_bucket(n)
+            # Pad the admission batch by replicating the LAST request:
+            # padding rows re-scatter into the same slot (idempotent).
+            # Encode BEFORE claiming slots so a failed encode (bad row,
+            # OOM) leaks nothing.
+            reqs = list(prepared) + [prepared[-1]] * (A - n)
+            rows = self.engine.encode_prepared_rows(reqs)
+            slots = [self.free.pop() for _ in range(n)]
+            for s in slots:
+                if s in self.occupied:  # pragma: no cover — invariant
+                    raise RuntimeError(f"slot {s} double-assigned")
+            slot_arr = jnp.asarray(
+                np.asarray(slots + [slots[-1]] * (A - n), np.int32)
+            )
+            for s, d in zip(slots, datas):
+                self.occupied[s] = d
+                self.steps_paid[s] = 0
+        else:
+            A = 0
+            slot_arr = rows = None
+        self._st, done, self._seqs_d, self._scores_d = self._tick_fn(A)(
+            self.engine.params, self._st, slot_arr, rows
+        )
+        self._seqs_np = self._scores_np = None
+        for s in self.occupied:
+            self.steps_paid[s] += self.block
+        done_np = np.asarray(jax.device_get(done))
+        return [s for s in self.occupied if bool(done_np[s])]
+
+    def harvest_many(
+        self, slots: Sequence[int]
+    ) -> List[Tuple[Any, np.ndarray, float, int]]:
+        """Extract done slots' best hypotheses from the last tick's
+        outputs (no device call beyond fetching them) and free the
+        slots.  Returns ``[(data, tokens (L,) int32, score, steps),
+        ...]`` in ``slots`` order."""
+        if not slots:
+            return []
+        for s in slots:
+            if s not in self.occupied:
+                raise RuntimeError(f"harvest of unoccupied slot {s}")
+        if self._seqs_np is None:
+            self._seqs_np = np.asarray(jax.device_get(self._seqs_d))
+            self._scores_np = np.asarray(jax.device_get(self._scores_d))
+        seqs = self._seqs_np[list(slots)]                 # (n, K, L)
+        if self.greedy:
+            best = np.zeros((len(slots),), int)
+            final = np.zeros((len(slots), 1), np.float32)
+        else:
+            scores = self._scores_np[list(slots)]         # (n, K)
+            if self.length_normalize:
+                lengths = np.maximum((seqs != PAD_ID).sum(-1), 1)
+                final = scores / lengths.astype(np.float32)
+            else:
+                final = scores
+            best = np.argsort(-final, axis=-1, kind="stable")[:, 0]
+        out = []
+        for i, slot in enumerate(slots):
+            data = self.occupied.pop(slot)
+            steps = min(self.steps_paid.pop(slot), self.L)
+            self.free.append(slot)
+            out.append((
+                data,
+                seqs[i, best[i]],
+                float(final[i, best[i]]),
+                steps,
+            ))
+        return out
+
+    def harvest(self, slot: int) -> Tuple[np.ndarray, float, int]:
+        """Single-slot harvest (tests / tools)."""
+        _, tokens, score, steps = self.harvest_many([slot])[0]
+        return tokens, score, steps
+
+    def evict(self, slot: int) -> Any:
+        """Free a slot WITHOUT extracting (drain-deadline abandonment).
+        Returns the caller data so its future can be failed."""
+        data = self.occupied.pop(slot)
+        self.steps_paid.pop(slot, None)
+        self.free.append(slot)
+        return data
+
+    def drain(self) -> List[Tuple[Any, np.ndarray, float, int]]:
+        """Run the loop with no admissions until every occupied slot
+        finishes; harvest everything.  (Tests / shutdown convenience.)"""
+        out = []
+        while self.occupied:
+            done = self.tick()
+            out.extend(self.harvest_many(done))
+        return out
+
+    def warmup(self) -> None:
+        """Compile every tick variant (one per admission bucket, plus
+        the pure-step variant) so the first served request never pays
+        XLA compile latency."""
+        req = self.engine.template_prepared()
+        for A in self._admit_buckets:
+            done = self.tick([req] * A, [None] * A)
+            self.harvest_many(done)
+            self.drain()
+        assert not self.occupied and len(self.free) == self.S
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "slots": self.S,
+            "rows_per_slot": self.K,
+            "block_steps": self.block,
+            "max_steps": self.L,
+            "mode": "greedy" if self.greedy else "beam",
+            "admit_cap": self.admit_cap,
+        }

@@ -447,6 +447,13 @@ class Trainer:
             if flush_metrics:
                 for k, v in flush_metrics.items():
                     acc.setdefault(k, []).append(v)
+        # Throughput truth: every dispatched step is asynchronous, so the
+        # clock must not be read until the device has actually finished
+        # the last update — block on the (possibly flushed) state before
+        # timing, so steps_per_sec is completed-steps/s, not the rate at
+        # which this host enqueued work.
+        jax.block_until_ready(self.state.params)
+        elapsed_s = max(time.time() - t0, 1e-9)
         out = {
             f"train_{k}" if k == "loss" else k: float(
                 np.mean([float(x) for x in v])
@@ -455,7 +462,7 @@ class Trainer:
         }
         out.setdefault("train_loss", float("nan"))
         out["ss_prob"] = ss_prob
-        out["steps_per_sec"] = nsteps / max(time.time() - t0, 1e-9)
+        out["steps_per_sec"] = nsteps / elapsed_s
         return out
 
     # ---------------------------------------------------------- evaluation

@@ -19,7 +19,37 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import time  # noqa: E402
+
 import pytest  # noqa: E402
+
+_SESSION_T0 = time.monotonic()
+
+
+def pytest_configure(config):
+    # Tier-1 runs `-m 'not slow'` (ROADMAP.md): anything marked slow is
+    # excluded from the runtime-budgeted suite.
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy test excluded from the tier-1 budgeted run "
+        "(pytest -m 'not slow')",
+    )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Tier-1 runtime guard: with TIER1_BUDGET_S set (seconds), a run
+    that exceeds the budget FAILS even if every test passed — so a new
+    expensive test can't silently eat the suite's timeout headroom; mark
+    it ``slow`` instead."""
+    budget = float(os.environ.get("TIER1_BUDGET_S", "0") or 0)
+    elapsed = time.monotonic() - _SESSION_T0
+    if budget and elapsed > budget and session.exitstatus == 0:
+        print(
+            f"\nTIER1 BUDGET EXCEEDED: suite took {elapsed:.0f}s > "
+            f"TIER1_BUDGET_S={budget:.0f}s — mark new heavy tests "
+            "@pytest.mark.slow (see tests/conftest.py)"
+        )
+        session.exitstatus = 1
 
 
 @pytest.fixture(scope="session")
