@@ -9,9 +9,13 @@ Loads the checkpoint once, pre-jits the decode paths, and serves
 ``POST /v1/caption`` (plus ``/healthz``, ``/metrics``, ``/stats``)
 through the continuous in-flight batching scheduler (slot-based
 persistent decode; ``--serving.continuous false`` falls back to the
-batch-at-a-time shape ladder) — see docs/SERVING.md.  SIGTERM drains
-gracefully: admissions 503, in-flight work finishes within
-``--serving.drain_timeout_s``.
+batch-at-a-time shape ladder) — see docs/SERVING.md.  With
+``--serving.replicas`` != 1 (0 = one per local device, the
+``msrvtt_serve_beam5`` preset default) the engine is replicated
+data-parallel across devices behind a least-loaded router with
+double-buffered tick dispatch (docs/SERVING.md "Scaling out").
+SIGTERM drains gracefully: admissions 503, in-flight work finishes
+within ``--serving.drain_timeout_s``.
 
 ``--random-init`` serves freshly-initialized weights instead of a
 checkpoint (load testing / smoke runs only — the captions are noise).
@@ -53,6 +57,10 @@ def main(argv=None) -> int:
         random_init=known.random_init,
     )
     server = CaptionServer(engine)
+    if hasattr(server.batcher, "replicas"):
+        logging.getLogger("cst_captioning_tpu.serving").info(
+            "replica set: %s", server.batcher.describe()
+        )
     try:
         server.serve_forever()
     except KeyboardInterrupt:

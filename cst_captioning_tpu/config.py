@@ -211,6 +211,22 @@ class ServingConfig:
     # Decode slots for continuous mode (greedy: 1 row/slot; beam: K
     # contiguous rows/slot).  0 = max_batch_size.
     num_slots: int = 0
+    # Data-parallel engine replicas (serving/replicas.py): one warm
+    # engine + slot decoder per replica, weights device_put once per
+    # replica, a least-loaded router in front.  1 = the single-replica
+    # scheduler (ContinuousBatcher); 0 = one replica per local device;
+    # N > len(devices) wraps round-robin onto the same devices.
+    replicas: int = 1
+    # Router policy across replica admission queues: "least_loaded"
+    # (most free slots minus queued work wins, round-robin tiebreak) or
+    # "round_robin".
+    router: str = "least_loaded"
+    # Double-buffered tick dispatch in each replica worker: dispatch
+    # tick t+1 before harvesting tick t, overlapping host-side
+    # harvest/detokenize/admission with device compute.  Costs one
+    # extra (frozen, parity-neutral) tick block of latency per caption
+    # tail; False = the synchronous one-sync-per-tick loop.
+    double_buffer: bool = True
     # Device decode steps per jitted slot-loop call (>=1).  Raising it
     # amortizes per-call dispatch + host-sync overhead at the price of
     # admission/exit granularity (a finished slot rides frozen for up
@@ -390,6 +406,9 @@ def _preset_msrvtt_serve() -> Config:
     # at 256MiB of host RAM regardless of entry count.
     c.serving.feature_cache_bytes = 256 * 1024 * 1024
     c.serving.num_slots = 64
+    # Production default: replicate the engine over every local chip
+    # (serving/replicas.py) with double-buffered dispatch.
+    c.serving.replicas = 0
     return c
 
 

@@ -20,7 +20,14 @@ fixed-shape discipline as training:
 * ``slots``   — the persistent slot-based decode loop behind
   continuous mode: S device-resident decode slots stepped one decode
   step at a time, freed on EOS/length-cap, refilled by
-  ``dynamic_update_slice`` admission at step boundaries.
+  ``dynamic_update_slice`` admission at step boundaries; splittable
+  into async ``tick_begin``/``tick_wait`` halves for double-buffered
+  dispatch.
+* ``replicas``— multi-replica data-parallel serving: one warm engine +
+  slot decoder per local device behind a least-loaded router, with
+  double-buffered tick dispatch per worker and unhealthy-replica
+  drain/requeue (``serving.replicas``; the default scheduler when
+  ``replicas != 1``).
 * ``cache``   — two-tier LRU: content-hash -> decoded caption, and
   feature-id -> projected encoder state (skips the encode GEMMs on the
   scan beam path via ``decoding.beam.beam_search_from_state``).
@@ -47,6 +54,12 @@ from cst_captioning_tpu.serving.metrics import (  # noqa: F401
     Gauge,
     LatencyHistogram,
     ServingMetrics,
+)
+from cst_captioning_tpu.serving.replicas import (  # noqa: F401
+    NoHealthyReplicasError,
+    Replica,
+    ReplicaSet,
+    Router,
 )
 from cst_captioning_tpu.serving.server import CaptionServer  # noqa: F401
 from cst_captioning_tpu.serving.slots import SlotDecoder  # noqa: F401

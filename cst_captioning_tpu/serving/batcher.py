@@ -182,6 +182,20 @@ class _BatcherBase:
         with self._cond:
             return len(self._q)
 
+    def _running(self) -> bool:
+        """Whether the scheduler thread(s) are up (overridden by
+        multi-worker subclasses)."""
+        return self._thread is not None
+
+    def _enqueue(self, pending: "_Pending") -> None:
+        """Admit one request into the (bounded) queue.  Called under
+        ``self._cond``; raises :class:`BackpressureError` when full.
+        Subclasses override to route across several queues."""
+        if len(self._q) >= self.queue_depth:
+            self.metrics.requests_rejected.inc()
+            raise BackpressureError(self.retry_after_s)
+        self._q.append(pending)
+
     # -------------------------------------------------------------- submit
     def submit(
         self,
@@ -197,7 +211,7 @@ class _BatcherBase:
         :class:`DeadlineExceededError` or :class:`ShuttingDownError`
         (drain in progress).
         """
-        if self._thread is None:
+        if not self._running():
             raise RuntimeError(f"{type(self).__name__} not started")
         if self._draining:
             raise ShuttingDownError("server is draining")
@@ -228,11 +242,8 @@ class _BatcherBase:
         with self._cond:
             if self._draining:
                 raise ShuttingDownError("server is draining")
-            if len(self._q) >= self.queue_depth:
-                self.metrics.requests_rejected.inc()
-                raise BackpressureError(self.retry_after_s)
+            self._enqueue(pending)
             self.metrics.requests_total.inc()
-            self._q.append(pending)
             self._cond.notify_all()
         # Generous slack: expiry is enforced by the scheduler (which
         # owns the clock for queued requests) and by the engine-call

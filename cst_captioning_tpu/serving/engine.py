@@ -147,6 +147,11 @@ class InferenceEngine:
         self._state_fns: Dict[int, Any] = {}
         self._fused_at: Dict[int, bool] = {}
         self._slot_decoder = None
+        # Data-parallel replica identity (serving/replicas.py): the
+        # device this engine's weights are committed to, or None for
+        # the default single-engine placement.
+        self.device = None
+        self.replica_id: Optional[int] = None
         if sv.warmup:
             self.warmup()
 
@@ -586,6 +591,35 @@ class InferenceEngine:
                 {"caption": res.caption, "tokens": res.tokens},
             )
         return res
+
+    def clone_for_device(self, device, replica_id: Optional[int] = None):
+        """A data-parallel replica of this engine on ``device``: the
+        SAME weights ``device_put`` once onto the target device, the
+        same vocabulary, and the SHARED two-tier cache — but its own
+        jit caches and its own :class:`SlotDecoder`, so every replica's
+        decode runs on its device with no cross-replica device sync.
+
+        The clone inherits this engine's ``params_tag`` verbatim:
+        replicas serve one logical model, so a tier-1 caption cached by
+        any replica must hit for all of them.  ``device_put`` copies
+        bytes — it cannot change any decoded token — which is why
+        cross-replica serving stays token-exact vs the offline
+        ``evaluation.py`` path (pinned in tests/test_replicas.py).
+
+        With ``serving.warmup`` enabled the clone pre-jits its ladder
+        and slot loop at construction ("one warm engine per device")."""
+        import copy
+
+        eng = InferenceEngine(
+            copy.deepcopy(self.cfg),
+            params=jax.device_put(self.params, device),
+            vocab=self.vocab,
+            cache=self.cache,
+        )
+        eng.params_tag = self.params_tag
+        eng.device = device
+        eng.replica_id = replica_id
+        return eng
 
     def slot_decoder(self):
         """The engine's persistent :class:`~cst_captioning_tpu.serving.

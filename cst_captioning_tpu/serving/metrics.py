@@ -11,6 +11,7 @@ does not change any serving decision).
 from __future__ import annotations
 
 import threading
+import time
 from typing import Dict, List, Optional
 
 # Log-spaced bucket UPPER bounds in milliseconds, 50us .. 60s.  The tail
@@ -149,6 +150,19 @@ class LatencyHistogram:
             return list(self._counts)
 
 
+class ReplicaMetrics:
+    """Per-replica label set (serving/replicas.py): exported on
+    ``/metrics`` as ``caption_replica_*{replica="<id>"}`` series."""
+
+    def __init__(self) -> None:
+        self.healthy = Gauge()           # 1 routed / 0 drained
+        self.slots_occupied = Gauge()
+        self.queue_depth = Gauge()
+        self.captions_total = Counter()  # rate() -> captions/s
+        self.admitted_total = Counter()
+        self.steps_total = Counter()     # device decode steps run
+
+
 class ServingMetrics:
     """All serving-side observability in one object, shared by the
     batcher, the engine, and the HTTP front end."""
@@ -172,8 +186,25 @@ class ServingMetrics:
         self.slot_steps_total = Counter()   # device decode steps run
         # Decode steps each caption actually paid before its slot freed.
         self.steps_per_caption = LatencyHistogram(STEP_BUCKETS)
+        # Per-replica label sets, created on first use (replica ids are
+        # small ints from ReplicaSet; str-keyed for label rendering).
+        self._replicas: Dict[str, ReplicaMetrics] = {}
+        self._replicas_lock = threading.Lock()
+        self._t0 = time.monotonic()
 
     # ------------------------------------------------------------- views
+    def replica(self, rid) -> ReplicaMetrics:
+        """The label set for replica ``rid`` (created on first use)."""
+        key = str(rid)
+        with self._replicas_lock:
+            if key not in self._replicas:
+                self._replicas[key] = ReplicaMetrics()
+            return self._replicas[key]
+
+    def _replica_items(self):
+        with self._replicas_lock:
+            return sorted(self._replicas.items())
+
     def observe_stage(self, stage: str, ms: float) -> None:
         self.stages[stage].observe(ms)
 
@@ -204,6 +235,23 @@ class ServingMetrics:
             },
             "latency_ms": {s: h.snapshot() for s, h in self.stages.items()},
         }
+        reps = self._replica_items()
+        if reps:
+            elapsed = max(time.monotonic() - self._t0, 1e-9)
+            d["replicas"] = {
+                rid: {
+                    "healthy": rm.healthy.value,
+                    "slots_occupied": rm.slots_occupied.value,
+                    "queue_depth": rm.queue_depth.value,
+                    "captions": rm.captions_total.value,
+                    "captions_per_sec": round(
+                        rm.captions_total.value / elapsed, 3
+                    ),
+                    "admitted": rm.admitted_total.value,
+                    "device_steps": rm.steps_total.value,
+                }
+                for rid, rm in reps
+            }
         if cache_stats is not None:
             d["cache"] = cache_stats
         return d
@@ -233,6 +281,28 @@ class ServingMetrics:
         ):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {g.value}")
+        reps = self._replica_items()
+        if reps:
+            families = (
+                ("caption_replica_healthy", "gauge",
+                 lambda rm: rm.healthy.value),
+                ("caption_replica_slots_occupied", "gauge",
+                 lambda rm: rm.slots_occupied.value),
+                ("caption_replica_queue_depth", "gauge",
+                 lambda rm: rm.queue_depth.value),
+                ("caption_replica_captions_total", "counter",
+                 lambda rm: rm.captions_total.value),
+                ("caption_replica_admitted_total", "counter",
+                 lambda rm: rm.admitted_total.value),
+                ("caption_replica_device_steps_total", "counter",
+                 lambda rm: rm.steps_total.value),
+            )
+            for name, typ, read in families:
+                lines.append(f"# TYPE {name} {typ}")
+                for rid, rm in reps:
+                    lines.append(
+                        f'{name}{{replica="{rid}"}} {read(rm)}'
+                    )
         hists = dict(
             {
                 f"caption_latency_{s}_ms": h

@@ -1,0 +1,500 @@
+"""Multi-replica data-parallel serving: N warm engines behind one door.
+
+The PR-3 serving stack is pinned to one device: a single
+``InferenceEngine`` and one ``SlotDecoder`` tick loop, one host sync
+per tick.  This module scales it across all local accelerators the way
+Orca/vLLM-class servers do — replicate the decode engine, schedule in
+front of it:
+
+* :class:`ReplicaSet` — the multi-replica scheduler (a drop-in batcher
+  for :class:`~cst_captioning_tpu.serving.server.CaptionServer`).  It
+  builds one :class:`Replica` per device — a warm
+  :class:`~cst_captioning_tpu.serving.engine.InferenceEngine` clone
+  whose weights were ``device_put`` ONCE onto that device
+  (``InferenceEngine.clone_for_device``) plus that engine's persistent
+  ``SlotDecoder`` — and runs one worker thread per replica.
+* :class:`Router` — in front of the per-replica admission queues:
+  ``least_loaded`` routes each accepted request to the replica with the
+  most free capacity (free slots minus queued work), breaking ties
+  round-robin so equal replicas interleave; ``round_robin`` ignores
+  load.  Routing happens at accept time under the shared lock, so a
+  request is assigned to exactly one replica (the decoder additionally
+  hard-raises on any slot double-assignment).
+* **Double-buffered tick dispatch** (``serving.double_buffer``) inside
+  each worker: dispatch tick *t+1* (``SlotDecoder.tick_begin``) BEFORE
+  waiting on tick *t* (``tick_wait`` + ``harvest_from``), so the
+  host-side harvest/detokenize/cache/admission work of tick *t*
+  overlaps the device compute of tick *t+1* — and, across replicas,
+  every other replica's compute.  The synchronous loop instead pays
+  (host work + device step) serially per tick.  Parity: a finished
+  slot rides the one extra buffered tick frozen (PAD-only, a no-op on
+  tokens/scores — see serving/slots.py), so buffering cannot change
+  any caption.
+* **Replica failure**: a worker that dies (device error, poisoned
+  state) marks its replica unhealthy, drains it from routing, and
+  requeues its queued AND in-flight requests onto surviving replicas —
+  each bounded by its original deadline (an already-expired request
+  fails with ``DeadlineExceededError``, never silently).  Requeued
+  in-flight work restarts from step 0 on the survivor; per-step math is
+  row-independent, so the survivor's caption is the same caption.
+  ``kill_replica`` is the operational handle for the same path.  With
+  ZERO healthy replicas, ``submit`` fails with
+  :class:`NoHealthyReplicasError` (HTTP 503) and ``/healthz`` degrades.
+
+Token-exactness: every replica holds byte-identical weights
+(``device_put`` copies, it does not compute), runs the same jitted
+per-step math as the single-replica slot loop, and shares the tier-1/2
+cache under the same ``params_tag`` — so WHICH replica decodes a
+request cannot change its tokens.  Pinned against offline
+``evaluation.py`` by the fuzz tests in tests/test_replicas.py on the
+8-device virtual CPU platform.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Any, Deque, List, Optional, Sequence
+
+from cst_captioning_tpu.serving.batcher import (
+    BackpressureError,
+    ShuttingDownError,
+    _BatcherBase,
+    _Pending,
+)
+from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+_log = logging.getLogger("cst_captioning_tpu.serving")
+
+ROUTER_POLICIES = ("least_loaded", "round_robin")
+
+
+class NoHealthyReplicasError(ShuttingDownError):
+    """Every replica is unhealthy — the server cannot serve (503)."""
+
+
+class _ReplicaDied(Exception):
+    """Internal: raised inside a worker loop when its replica was
+    marked unhealthy (kill_replica / external drain)."""
+
+
+class Router:
+    """Replica selection policy.  ``pick`` is called under the
+    ReplicaSet lock with the current healthy candidates."""
+
+    def __init__(self, policy: str = "least_loaded"):
+        if policy not in ROUTER_POLICIES:
+            raise ValueError(
+                f"unknown router policy {policy!r}; have {ROUTER_POLICIES}"
+            )
+        self.policy = policy
+        self._rr = 0
+
+    def pick(self, replicas: Sequence["Replica"]) -> "Replica":
+        """Pick one of ``replicas`` (non-empty, all healthy)."""
+        if not replicas:
+            raise ValueError("router.pick with no candidates")
+        if self.policy == "round_robin":
+            r = replicas[self._rr % len(replicas)]
+        else:
+            best = max(r.free_capacity() for r in replicas)
+            tied = [r for r in replicas if r.free_capacity() == best]
+            r = tied[self._rr % len(tied)]
+        self._rr += 1
+        return r
+
+
+class Replica:
+    """One engine + slot decoder + admission queue + worker thread."""
+
+    def __init__(self, rid: int, engine):
+        self.rid = rid
+        self.engine = engine
+        self.decoder = engine.slot_decoder()
+        self.q: Deque[_Pending] = deque()
+        self.healthy = True
+        self.thread: Optional[threading.Thread] = None
+
+    def free_capacity(self) -> int:
+        """Free slots net of already-queued work (can go negative —
+        the router just prefers the least oversubscribed replica)."""
+        return self.decoder.S - self.decoder.n_occupied - len(self.q)
+
+
+class ReplicaSet(_BatcherBase):
+    """Multi-replica continuous-batching scheduler (see module doc).
+
+    Construct from pre-built engines (``ReplicaSet(engines, ...)`` —
+    each engine must be a distinct object with its own slot decoder) or
+    from one loaded engine via :meth:`from_engine`, which clones it
+    onto local devices.  ``engines[0]`` doubles as the front engine for
+    host-side ``prepare``/cache lookups (any replica works: they share
+    the cache and the ``params_tag``)."""
+
+    _thread_name = "caption-replicas"
+
+    def __init__(
+        self,
+        engines: Sequence[Any],
+        metrics: Optional[ServingMetrics] = None,
+        *,
+        router: Optional[str] = None,
+        double_buffer: Optional[bool] = None,
+        queue_depth: Optional[int] = None,
+        default_deadline_ms: Optional[float] = None,
+        retry_after_s: Optional[float] = None,
+        drain_timeout_s: Optional[float] = None,
+    ):
+        if not engines:
+            raise ValueError("ReplicaSet needs at least one engine")
+        super().__init__(
+            engines[0],
+            metrics,
+            queue_depth=queue_depth,
+            default_deadline_ms=default_deadline_ms,
+            retry_after_s=retry_after_s,
+            drain_timeout_s=drain_timeout_s,
+        )
+        sv = engines[0].cfg.serving
+        self.router = Router(router if router is not None else sv.router)
+        self.double_buffer = bool(
+            sv.double_buffer if double_buffer is None else double_buffer
+        )
+        self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
+        self._threads: List[threading.Thread] = []
+        for rep in self.replicas:
+            rm = self.metrics.replica(rep.rid)
+            rm.healthy.set(1)
+            rm.slots_occupied.set(0)
+            rm.queue_depth.set(0)
+        self.metrics.slots_total.set(
+            sum(r.decoder.S for r in self.replicas)
+        )
+
+    @classmethod
+    def from_engine(
+        cls,
+        engine,
+        metrics: Optional[ServingMetrics] = None,
+        *,
+        n_replicas: Optional[int] = None,
+        devices: Optional[Sequence[Any]] = None,
+        **kw,
+    ) -> "ReplicaSet":
+        """Clone ``engine`` into N replicas over local devices
+        (``serving.replicas``; 0 = one per device).  More replicas than
+        devices wrap round-robin onto the same devices (useful on a
+        single-device host: the workers still overlap their host-side
+        work)."""
+        import jax
+
+        sv = engine.cfg.serving
+        n = sv.replicas if n_replicas is None else n_replicas
+        devs = list(devices if devices is not None else jax.devices())
+        if n <= 0:
+            n = len(devs)
+        engines = [
+            engine.clone_for_device(devs[i % len(devs)], replica_id=i)
+            for i in range(n)
+        ]
+        return cls(engines, metrics, **kw)
+
+    # ----------------------------------------------------------- lifecycle
+    def _running(self) -> bool:
+        return bool(self._threads)
+
+    def start(self) -> "ReplicaSet":
+        if self._threads:
+            return self
+        self._stop = False
+        self._draining = False
+        for rep in self.replicas:
+            t = threading.Thread(
+                target=self._worker,
+                args=(rep,),
+                name=f"caption-replica-{rep.rid}",
+                daemon=True,
+            )
+            rep.thread = t
+            self._threads.append(t)
+            t.start()
+        return self
+
+    def stop(self, drain: bool = True) -> None:
+        with self._cond:
+            self._draining = True
+            self._drain = drain
+            self._stop = True
+            self._cond.notify_all()
+        for t in self._threads:
+            t.join(timeout=self.drain_timeout_s + 60.0)
+        self._threads = []
+        # Fail anything still queued anywhere (drain disabled, drain
+        # deadline blown, or worker death) so no submitter blocks.
+        with self._cond:
+            for rep in self.replicas:
+                while rep.q:
+                    p = rep.q.popleft()
+                    if not p.future.done():
+                        p.future.set_exception(
+                            RuntimeError("replica set stopped")
+                        )
+                self.metrics.replica(rep.rid).queue_depth.set(0)
+
+    @property
+    def healthy_replicas(self) -> int:
+        return sum(1 for r in self.replicas if r.healthy)
+
+    @property
+    def depth(self) -> int:
+        with self._cond:
+            return sum(len(r.q) for r in self.replicas)
+
+    def kill_replica(self, rid: int) -> None:
+        """Operational drain of one replica: mark it unhealthy and stop
+        routing to it; its worker requeues the replica's queued and
+        in-flight requests onto survivors (deadline-bounded)."""
+        with self._cond:
+            self.replicas[rid].healthy = False
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------- routing
+    def _enqueue(self, pending: _Pending) -> None:
+        healthy = [r for r in self.replicas if r.healthy]
+        if not healthy:
+            raise NoHealthyReplicasError("no healthy replicas")
+        if sum(len(r.q) for r in self.replicas) >= self.queue_depth:
+            self.metrics.requests_rejected.inc()
+            raise BackpressureError(self.retry_after_s)
+        rep = self.router.pick(healthy)
+        rep.q.append(pending)
+        self.metrics.replica(rep.rid).queue_depth.set(len(rep.q))
+
+    # ------------------------------------------------------------- workers
+    def _worker(self, rep: Replica) -> None:
+        try:
+            self._worker_loop(rep)
+        except _ReplicaDied:
+            self._drain_replica(rep, f"replica {rep.rid} killed")
+        except Exception:  # noqa: BLE001 — any worker death drains it
+            _log.exception("replica %d worker died", rep.rid)
+            self._drain_replica(rep, f"replica {rep.rid} worker died")
+
+    def _worker_loop(self, rep: Replica) -> None:
+        decoder = rep.decoder
+        rm = self.metrics.replica(rep.rid)
+        admit_max = min(decoder.admit_cap, decoder.S)
+        outstanding = None          # un-waited TickHandle (double buffer)
+        drain_deadline: Optional[float] = None
+        while True:
+            admits: List[_Pending] = []
+            with self._cond:
+                while (
+                    not rep.q
+                    and not decoder.occupied
+                    and outstanding is None
+                    and not self._stop
+                    and rep.healthy
+                ):
+                    self._cond.wait(timeout=0.1)
+                if not rep.healthy:
+                    raise _ReplicaDied()
+                if self._stop:
+                    if not self._drain:
+                        break
+                    if (
+                        not rep.q
+                        and not decoder.occupied
+                        and outstanding is None
+                    ):
+                        return
+                    if drain_deadline is None:
+                        drain_deadline = (
+                            time.monotonic() + self.drain_timeout_s
+                        )
+                cap = min(len(decoder.free), admit_max)
+                while rep.q and len(admits) < cap:
+                    admits.append(rep.q.popleft())
+                rm.queue_depth.set(len(rep.q))
+            if (
+                drain_deadline is not None
+                and time.monotonic() > drain_deadline
+            ):
+                self._abandon(rep, admits, "drain deadline exceeded")
+                return
+
+            now = time.monotonic()
+            live: List[_Pending] = []
+            for p in admits:
+                if now > p.deadline:
+                    self._expire(p, now)
+                else:
+                    live.append(p)
+            # Dispatch tick t+1 FIRST (double buffer) so the harvest of
+            # tick t below overlaps its device compute.
+            try:
+                handle = decoder.tick_begin(
+                    [p.prepared for p in live], live
+                )
+            except Exception as e:  # noqa: BLE001
+                # A failed admission encode fails those submitters and
+                # the replica keeps serving; a failure with nothing to
+                # admit is the step itself dying: replica death.
+                self.metrics.requests_failed.inc(len(live))
+                for p in live:
+                    if not p.future.done():
+                        p.future.set_exception(e)
+                if not live:
+                    raise
+                continue
+            t_admit = time.monotonic()
+            for p in live:
+                p.t_admit = t_admit
+                self.metrics.observe_stage(
+                    "admission", (t_admit - p.t_enqueue) * 1e3
+                )
+            if live:
+                self.metrics.slots_admitted_total.inc(len(live))
+                rm.admitted_total.inc(len(live))
+            if handle is not None:
+                self.metrics.slot_steps_total.inc(decoder.block)
+                rm.steps_total.inc(decoder.block)
+            rm.slots_occupied.set(decoder.n_occupied)
+            self.metrics.slots_occupied.set(
+                sum(r.decoder.n_occupied for r in self.replicas)
+            )
+            if self.double_buffer:
+                to_wait, outstanding = outstanding, handle
+            else:
+                to_wait, outstanding = handle, None
+            if to_wait is not None:
+                done = decoder.tick_wait(to_wait)
+                if done:
+                    self._resolve(
+                        rep, rm, decoder.harvest_from(to_wait, done)
+                    )
+                    rm.slots_occupied.set(decoder.n_occupied)
+
+        # Hard stop (drain=False): fail whatever is still in flight;
+        # queued requests are failed by stop() after the join.
+        self._abandon(rep, [], "replica set stopped")
+
+    def _resolve(self, rep: Replica, rm, harvested) -> None:
+        """Detokenize + cache + resolve futures for one harvest batch
+        (identical semantics to ContinuousBatcher._resolve, plus the
+        per-replica caption counter)."""
+        t0 = time.monotonic()
+        for p, tokens, score, steps in harvested:
+            self.metrics.steps_per_caption.observe(steps)
+            self.metrics.observe_stage("device", (t0 - p.t_admit) * 1e3)
+            try:
+                res = rep.engine.result_from_tokens(
+                    p.prepared,
+                    tokens,
+                    {
+                        "admission_ms": (p.t_admit - p.t_enqueue) * 1e3,
+                        "device_ms": (t0 - p.t_admit) * 1e3,
+                    },
+                )
+            except Exception as e:  # noqa: BLE001
+                self.metrics.requests_failed.inc()
+                if not p.future.done():
+                    p.future.set_exception(e)
+                continue
+            t1 = time.monotonic()
+            self.metrics.observe_stage("detok", (t1 - t0) * 1e3)
+            self.metrics.requests_served.inc()
+            rm.captions_total.inc()
+            if not p.future.done():
+                p.future.set_result({
+                    "caption": res.caption,
+                    "tokens": res.tokens,
+                    "cached": False,
+                    "score": score,
+                    "replica": rep.rid,
+                    "timings_ms": dict(
+                        res.timings_ms,
+                        detok_ms=(t1 - t0) * 1e3,
+                        decode_steps=steps,
+                    ),
+                })
+
+    def _abandon(
+        self, rep: Replica, admits: List[_Pending], why: str
+    ) -> None:
+        for p in admits:
+            if not p.future.done():
+                self.metrics.requests_failed.inc()
+                p.future.set_exception(RuntimeError(why))
+        for slot in list(rep.decoder.occupied):
+            p = rep.decoder.evict(slot)
+            if p is not None and not p.future.done():
+                self.metrics.requests_failed.inc()
+                p.future.set_exception(RuntimeError(why))
+        self.metrics.replica(rep.rid).slots_occupied.set(0)
+
+    # -------------------------------------------------------- failure path
+    def _drain_replica(self, rep: Replica, why: str) -> None:
+        """Mark ``rep`` unhealthy, drain it from routing, and requeue
+        its queued + in-flight requests onto surviving replicas —
+        bounded by each request's original deadline.  Runs on the dying
+        worker's own thread (the decoder's single owner)."""
+        requeued = expired = failed = 0
+        with self._cond:
+            rep.healthy = False
+            rm = self.metrics.replica(rep.rid)
+            rm.healthy.set(0)
+            pendings: List[Optional[_Pending]] = list(rep.q)
+            rep.q.clear()
+            rm.queue_depth.set(0)
+            for slot in list(rep.decoder.occupied):
+                pendings.append(rep.decoder.evict(slot))
+            rm.slots_occupied.set(0)
+            survivors = [r for r in self.replicas if r.healthy]
+            now = time.monotonic()
+            for p in pendings:
+                if p is None or p.future.done():
+                    continue
+                if now > p.deadline:
+                    self._expire(p, now)
+                    expired += 1
+                elif survivors:
+                    # Accepted work is never dropped: requeue even past
+                    # queue_depth (the bound gates NEW admissions only).
+                    r2 = self.router.pick(survivors)
+                    r2.q.append(p)
+                    self.metrics.replica(r2.rid).queue_depth.set(
+                        len(r2.q)
+                    )
+                    requeued += 1
+                else:
+                    self.metrics.requests_failed.inc()
+                    p.future.set_exception(
+                        RuntimeError(f"{why}; no healthy replicas left")
+                    )
+                    failed += 1
+            self.metrics.slots_total.set(
+                sum(r.decoder.S for r in self.replicas if r.healthy)
+            )
+            self._cond.notify_all()
+        _log.warning(
+            "%s: drained from routing (%d requeued, %d expired, "
+            "%d failed; %d healthy replicas remain)",
+            why, requeued, expired, failed, self.healthy_replicas,
+        )
+
+    # ----------------------------------------------------------------- info
+    def describe(self) -> dict:
+        return {
+            "replicas": len(self.replicas),
+            "healthy": self.healthy_replicas,
+            "router": self.router.policy,
+            "double_buffer": self.double_buffer,
+            "devices": [
+                str(getattr(r.engine, "device", None))
+                for r in self.replicas
+            ],
+            "slots_per_replica": [r.decoder.S for r in self.replicas],
+        }

@@ -8,7 +8,9 @@ Endpoints:
   input), 404 (unknown ``feature_id`` with no features), 429 (queue
   full; ``Retry-After`` header set), 503 (draining/shutdown), 504
   (deadline exceeded), 500 (engine failure).
-* ``GET /healthz`` — liveness + engine description.
+* ``GET /healthz`` — liveness + engine description (+ replica health
+  under the multi-replica scheduler: 503 only when ZERO replicas are
+  healthy — individual replica deaths degrade capacity, not health).
 * ``GET /metrics`` — Prometheus text exposition (per-stage latency
   histograms, slot occupancy, request counters, cache tiers).
 * ``GET /stats``  — the same numbers as one JSON object.
@@ -17,9 +19,12 @@ Endpoints:
 matches the batcher ``submit`` blocking contract; the batcher's bounded
 queue — not the thread pool — is the backpressure surface.
 
-The scheduler behind ``submit`` is picked by ``serving.continuous``:
-the slot-based continuous batcher (default) or the PR-2 shape-ladder
-micro-batcher (fallback) — see serving/batcher.py.
+The scheduler behind ``submit`` is picked by ``serving.continuous`` and
+``serving.replicas``: the multi-replica data-parallel ``ReplicaSet``
+(``replicas != 1``; one warm engine per device behind a least-loaded
+router — serving/replicas.py), the single-replica slot-based continuous
+batcher, or the PR-2 shape-ladder micro-batcher (fallback) — see
+serving/batcher.py.
 
 Graceful shutdown: ``shutdown()`` (and SIGTERM under
 ``serve_forever``) first closes admissions — new requests get 503 while
@@ -86,9 +91,20 @@ class _Handler(BaseHTTPRequestHandler):
         srv = self.server
         if self.path == "/healthz":
             status = "draining" if srv.draining else "ok"
-            self._send_json(
-                200, {"status": status, **srv.engine.describe()}
-            )
+            info = srv.engine.describe()
+            code = 200
+            # Multi-replica scheduler: individual replica deaths keep
+            # the server healthy (degraded capacity); only ZERO healthy
+            # replicas makes /healthz fail.
+            healthy = getattr(srv.batcher, "healthy_replicas", None)
+            if healthy is not None:
+                info["replicas"] = {
+                    "healthy": healthy,
+                    "total": len(srv.batcher.replicas),
+                }
+                if healthy == 0:
+                    status, code = "unhealthy", 503
+            self._send_json(code, {"status": status, **info})
         elif self.path == "/metrics":
             body = srv.metrics.to_prometheus(
                 srv.engine.cache.stats()
@@ -175,8 +191,14 @@ class CaptionServer:
         self.engine = engine
         self.metrics = metrics or ServingMetrics()
         if batcher is None:
-            cls = ContinuousBatcher if sv.continuous else MicroBatcher
-            batcher = cls(engine, self.metrics)
+            if sv.continuous and sv.replicas != 1:
+                from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+                batcher = ReplicaSet.from_engine(engine, self.metrics)
+            elif sv.continuous:
+                batcher = ContinuousBatcher(engine, self.metrics)
+            else:
+                batcher = MicroBatcher(engine, self.metrics)
         self.batcher = batcher
         self._http = _Server(
             (host if host is not None else sv.host,

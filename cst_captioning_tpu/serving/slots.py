@@ -53,8 +53,33 @@ offline ``evaluation.py`` path, pinned by tests/test_serving.py):
   numpy with a stable argsort — the same tie behavior as the offline
   jnp epilogue.
 
+Double-buffered dispatch (``serving/replicas.py`` workers): the tick is
+split into :meth:`SlotDecoder.tick_begin` (admission scatter + step
+block DISPATCHED, no host sync — returns a :class:`TickHandle` holding
+the tick's output arrays) and :meth:`SlotDecoder.tick_wait` /
+:meth:`SlotDecoder.harvest_from` (sync + extract against a specific
+handle).  A worker that dispatches tick *t+1* before waiting on tick
+*t* overlaps its host-side harvest/detokenize/admission work with
+device compute.  Two guards keep that reordering exact:
+
+* every handle carries the tick's OWN functional outputs (``done`` /
+  ``seqs`` / ``scores`` are fresh arrays per jitted call), so
+  harvesting tick *t* after tick *t+1* was dispatched reads tick *t*'s
+  numbers, not *t+1*'s;
+* ``admit_tick`` records the tick at which each slot's occupant was
+  admitted, and ``tick_wait(handle)`` only reports slots admitted at or
+  before ``handle.seq`` — a slot harvested-then-refilled between
+  dispatch and wait can never be harvested from a stale handle.
+
+A finished slot rides frozen for the extra buffered tick (PAD-only
+continuation, a no-op on tokens/scores — the same parity argument as
+``slot_block_steps`` > 1), so double buffering cannot change any
+caption.  The synchronous :meth:`SlotDecoder.tick` is the composition
+``tick_begin`` + ``tick_wait`` and keeps the PR-3 behavior exactly.
+
 Threading: a ``SlotDecoder`` is owned by exactly one scheduler thread
-(``serving.batcher.ContinuousBatcher``); nothing here locks.
+(``serving.batcher.ContinuousBatcher`` or one ``ReplicaSet`` worker);
+nothing here locks.
 """
 
 from __future__ import annotations
@@ -83,6 +108,17 @@ def _buckets(top: int) -> List[int]:
         b *= 2
     out.append(top)
     return out
+
+
+class TickHandle(NamedTuple):
+    """One dispatched (possibly un-synced) tick: its sequence number and
+    its own output arrays.  ``done``/``seqs``/``scores`` are the jitted
+    call's functional outputs — later ticks never mutate them."""
+
+    seq: int
+    done: Any             # (S,) bool device array
+    seqs: Any             # (S, K, L) int32 device array
+    scores: Any           # (S, K) float32 device array
 
 
 class SlotState(NamedTuple):
@@ -131,12 +167,14 @@ class SlotDecoder:
         # Host-side slot bookkeeping (scheduler thread only).
         self.free: List[int] = list(range(self.S))
         self.occupied: Dict[int, Any] = {}      # slot -> caller's data
-        self.steps_paid: Dict[int, int] = {}    # slot -> device steps
+        self.admit_tick: Dict[int, int] = {}    # slot -> admission seq
         self._tick_fns: Dict[int, Any] = {}
-        # Post-tick snapshots consumed by harvest_many (device arrays;
-        # fetched lazily, at most once per tick).
-        self._seqs_d = None
-        self._scores_d = None
+        self._seq = 0                           # dispatched-tick counter
+        # Last dispatched handle (sync-path harvest target) and a host
+        # snapshot cache keyed by handle seq (fetched lazily, at most
+        # once per handle).
+        self._last_handle: Optional[TickHandle] = None
+        self._np_seq = -1
         self._seqs_np: Optional[np.ndarray] = None
         self._scores_np: Optional[np.ndarray] = None
         self._build_step()
@@ -167,7 +205,7 @@ class SlotDecoder:
         cache = jax.tree.map(
             lambda sds: jnp.zeros(sds.shape, sds.dtype), cache_shape
         )
-        return SlotState(
+        st = SlotState(
             h=jnp.zeros((model.num_layers, n, model.rnn_size), cdt),
             c=jnp.zeros((model.num_layers, n, model.rnn_size), jnp.float32),
             cache=cache,
@@ -178,6 +216,10 @@ class SlotDecoder:
             tokens=jnp.full((n,), BOS_ID, jnp.int32),
             step=jnp.full((S,), L, jnp.int32),
         )
+        # Replica engines pin their slot matrix to their device so the
+        # first tick doesn't silently run on the default device.
+        dev = getattr(self.engine, "device", None)
+        return st if dev is None else jax.device_put(st, dev)
 
     def _build_step(self) -> None:
         model, S, K, L, V = self.model, self.S, self.K, self.L, self.V
@@ -346,18 +388,20 @@ class SlotDecoder:
     def n_occupied(self) -> int:
         return len(self.occupied)
 
-    def tick(
+    def tick_begin(
         self,
         prepared: Sequence[Any] = (),
         datas: Sequence[Any] = (),
-    ) -> List[int]:
-        """One scheduler iteration: admit ``prepared`` (up to
-        ``admit_cap``; caller gates on ``free``) and run one step block
-        over all slots.  Returns the occupied slots that are now done
-        (all beams finished, or length cap)."""
+    ) -> Optional[TickHandle]:
+        """Dispatch one scheduler iteration WITHOUT a host sync: admit
+        ``prepared`` (up to ``admit_cap``; caller gates on ``free``) and
+        launch one step block over all slots.  Returns a
+        :class:`TickHandle` to pass to :meth:`tick_wait` /
+        :meth:`harvest_from`, or ``None`` when there is nothing to do
+        (no admissions, no occupied slots — no device work launched)."""
         n = len(prepared)
         if n == 0 and not self.occupied:
-            return []
+            return None
         if n > len(self.free) or n > self.admit_cap:
             raise RuntimeError(
                 f"tick admitting {n} exceeds free={len(self.free)} "
@@ -378,36 +422,79 @@ class SlotDecoder:
             slot_arr = jnp.asarray(
                 np.asarray(slots + [slots[-1]] * (A - n), np.int32)
             )
-            for s, d in zip(slots, datas):
-                self.occupied[s] = d
-                self.steps_paid[s] = 0
         else:
             A = 0
+            slots = []
             slot_arr = rows = None
-        self._st, done, self._seqs_d, self._scores_d = self._tick_fn(A)(
+        self._seq += 1
+        for s, d in zip(slots, datas):
+            self.occupied[s] = d
+            self.admit_tick[s] = self._seq
+        self._st, done, seqs_d, scores_d = self._tick_fn(A)(
             self.engine.params, self._st, slot_arr, rows
         )
-        self._seqs_np = self._scores_np = None
-        for s in self.occupied:
-            self.steps_paid[s] += self.block
-        done_np = np.asarray(jax.device_get(done))
-        return [s for s in self.occupied if bool(done_np[s])]
+        handle = TickHandle(self._seq, done, seqs_d, scores_d)
+        self._last_handle = handle
+        return handle
+
+    def tick_wait(self, handle: TickHandle) -> List[int]:
+        """Sync on ``handle``'s tick and return the occupied slots that
+        finished by it (all beams EOS, or length cap).  Slots whose
+        occupant was admitted AFTER the handle's tick are excluded —
+        their done flags in this handle describe the PREVIOUS occupant
+        (double-buffered dispatch admits into freed slots before the
+        older tick is waited on)."""
+        done_np = np.asarray(jax.device_get(handle.done))
+        return [
+            s for s in self.occupied
+            if bool(done_np[s]) and self.admit_tick[s] <= handle.seq
+        ]
+
+    def tick(
+        self,
+        prepared: Sequence[Any] = (),
+        datas: Sequence[Any] = (),
+    ) -> List[int]:
+        """One synchronous scheduler iteration (dispatch + sync):
+        ``tick_begin`` composed with ``tick_wait``.  Returns the
+        occupied slots that are now done."""
+        handle = self.tick_begin(prepared, datas)
+        if handle is None:
+            return []
+        return self.tick_wait(handle)
 
     def harvest_many(
         self, slots: Sequence[int]
     ) -> List[Tuple[Any, np.ndarray, float, int]]:
-        """Extract done slots' best hypotheses from the last tick's
-        outputs (no device call beyond fetching them) and free the
-        slots.  Returns ``[(data, tokens (L,) int32, score, steps),
-        ...]`` in ``slots`` order."""
+        """Extract done slots from the LAST dispatched tick's outputs
+        (the synchronous-loop path)."""
+        if not slots:
+            return []
+        if self._last_handle is None:
+            raise RuntimeError("harvest before any tick")
+        return self.harvest_from(self._last_handle, slots)
+
+    def harvest_from(
+        self, handle: TickHandle, slots: Sequence[int]
+    ) -> List[Tuple[Any, np.ndarray, float, int]]:
+        """Extract done slots' best hypotheses from ``handle``'s tick
+        outputs (no device call beyond fetching them once per handle)
+        and free the slots.  Returns ``[(data, tokens (L,) int32,
+        score, steps), ...]`` in ``slots`` order."""
         if not slots:
             return []
         for s in slots:
             if s not in self.occupied:
                 raise RuntimeError(f"harvest of unoccupied slot {s}")
-        if self._seqs_np is None:
-            self._seqs_np = np.asarray(jax.device_get(self._seqs_d))
-            self._scores_np = np.asarray(jax.device_get(self._scores_d))
+            if self.admit_tick[s] > handle.seq:  # pragma: no cover
+                raise RuntimeError(
+                    f"slot {s} admitted at tick {self.admit_tick[s]} > "
+                    f"harvest handle tick {handle.seq}"
+                )
+        if self._np_seq != handle.seq:
+            self._seqs_np = np.asarray(jax.device_get(handle.seqs))
+            self._scores_np = np.asarray(jax.device_get(handle.scores))
+            self._np_seq = handle.seq
         seqs = self._seqs_np[list(slots)]                 # (n, K, L)
         if self.greedy:
             best = np.zeros((len(slots),), int)
@@ -423,13 +510,16 @@ class SlotDecoder:
         out = []
         for i, slot in enumerate(slots):
             data = self.occupied.pop(slot)
-            steps = min(self.steps_paid.pop(slot), self.L)
+            # Device steps the caption paid: every dispatched tick from
+            # its admission tick through the handle's tick ran `block`
+            # steps over its rows.
+            paid = (handle.seq - self.admit_tick.pop(slot) + 1) * self.block
             self.free.append(slot)
             out.append((
                 data,
                 seqs[i, best[i]],
                 float(final[i, best[i]]),
-                steps,
+                min(paid, self.L),
             ))
         return out
 
@@ -442,7 +532,7 @@ class SlotDecoder:
         """Free a slot WITHOUT extracting (drain-deadline abandonment).
         Returns the caller data so its future can be failed."""
         data = self.occupied.pop(slot)
-        self.steps_paid.pop(slot, None)
+        self.admit_tick.pop(slot, None)
         self.free.append(slot)
         return data
 
