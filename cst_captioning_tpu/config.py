@@ -137,6 +137,23 @@ class TrainConfig:
     #              trainer flushes the final pending update at epoch ends).
     #   chunked  — force the chunked/two-dispatch layout.
     cst_split_layout: str = "auto"
+    # Parallel CIDEr-D reward pool (training/rewards.py::RewardPool):
+    # rollout rows shard across this many persistent worker processes,
+    # with the corpus document-frequency and cooked-reference tables
+    # pickled to the workers once at pool start.  Scores are
+    # BIT-IDENTICAL to serial scoring (rows are independent; shards
+    # concatenate in order — docs/PARITY.md).  0/1 = serial in-process
+    # scoring; ignored when the native C++ scorer (already threaded) is
+    # built.
+    reward_workers: int = 0
+    # Overlapped reward scheduling in the split CST step: feed rollout
+    # chunks to the scorer the moment their tokens are fetched (scoring
+    # proceeds in pool workers while the greedy-baseline decode still
+    # runs on device) and block only at the PG-update dispatch — step
+    # time approaches max(t_device, t_score) + t_update instead of the
+    # serial sum (docs/PERF.md).  Scheduling only: rewards and updates
+    # are bit-identical with this on or off.
+    overlap_rewards: bool = True
 
     optimizer: str = "adam"
     learning_rate: float = 2e-4
@@ -377,6 +394,11 @@ def _preset_msrvtt_cst_ms() -> Config:
     c.train.cst_weighted_reward = True  # 20-ref weighted CIDEr reward
     c.train.learning_rate = 1e-4
     c.train.start_from = "checkpoints/msrvtt_wxe_cst_gt_none/best"
+    # TPU-VM hosts have many idle cores during CST; shard the in-loop
+    # CIDEr-D scorer across 8 worker processes (bit-identical scores)
+    # so host scoring stays well under device decode time.  No-op when
+    # the native C++ scorer is built (it is already threaded).
+    c.train.reward_workers = 8
     return c
 
 

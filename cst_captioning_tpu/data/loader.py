@@ -211,10 +211,16 @@ def prefetch_to_device(
                 if not _put(Batch(**put)):
                     return
             _put(END)
-        except BaseException as e:  # surface worker errors to the consumer
+        except BaseException as e:  # noqa: BLE001
+            # Poison-pill the queue with the CAPTURED exception (its
+            # __traceback__ survives on the instance) so the consumer
+            # re-raises it instead of the epoch silently ending short.
             _put(e)
 
-    threading.Thread(target=worker, daemon=True).start()
+    thread = threading.Thread(
+        target=worker, daemon=True, name="prefetch_to_device"
+    )
+    thread.start()
     try:
         while True:
             item = q.get()
@@ -224,7 +230,16 @@ def prefetch_to_device(
                 raise item
             yield item
     finally:
-        # Abandoned mid-epoch (exception/GeneratorExit in the consumer):
-        # release the worker so it exits instead of blocking on a full
-        # queue holding device-resident batches.
+        # Abandoned mid-epoch (exception/GeneratorExit in the consumer)
+        # or finished: release the worker so it exits instead of
+        # blocking on a full queue, drain anything it already staged,
+        # and JOIN it — a crashed epoch must not leak a daemon thread
+        # holding device-resident batches (it would pin device memory
+        # for the life of the process).
         stop.set()
+        while True:
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
+        thread.join(timeout=10.0)

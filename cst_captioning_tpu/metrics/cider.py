@@ -151,6 +151,38 @@ def ciderd_score_cooked(
     return ciderd_score_vec(ctest, ref_vecs, doc_freq, log_ref_len, use_d)
 
 
+def ciderd_score_rows(
+    cands: List[Counter],
+    ref_vecs_rows: List[list],
+    doc_freq,
+    log_ref_len: float,
+    use_d: bool = True,
+    ref_weights_rows=None,
+) -> np.ndarray:
+    """Row-wise batch scoring: candidate ``i`` against ``ref_vecs_rows[i]``.
+
+    This is the single inner loop shared by the serial
+    :class:`~cst_captioning_tpu.training.rewards.CiderDRewarder` and the
+    :class:`~cst_captioning_tpu.training.rewards.RewardPool` workers —
+    rows are independent, so any contiguous sharding of this loop
+    concatenates back to the exact serial result bit-for-bit (the parity
+    contract the reward pool relies on, docs/PARITY.md).
+    """
+    out = np.zeros((len(cands),), np.float32)
+    for i, cand in enumerate(cands):
+        out[i] = ciderd_score_vec(
+            cand,
+            ref_vecs_rows[i],
+            doc_freq,
+            log_ref_len,
+            use_d=use_d,
+            ref_weights=(
+                None if ref_weights_rows is None else ref_weights_rows[i]
+            ),
+        )
+    return out
+
+
 # ------------------------------------------------------- string-based API
 
 class _CiderBase:

@@ -1,0 +1,93 @@
+"""Child-process side of the CST reward pool — a JAX-FREE module.
+
+``training/rewards.py::RewardPool`` starts its workers with the
+``forkserver`` method: the fork server is a CLEAN process (created by
+spawn, so it inherits none of the parent's threads), and every worker
+forks from it.  That choice is load-bearing — forking directly from a
+long-lived jax parent (dispatch threads, XLA thread pools) deadlocked
+reproducibly once the process had real mileage on it (a fork child can
+inherit a lock a parent thread held mid-operation), exactly the failure
+jax's ``os.fork()`` RuntimeWarning describes.
+
+The price of forkserver is that each worker imports this module at pool
+start.  This file therefore lives under ``metrics/`` (numpy-only import
+chain, ~0.1 s) and must NEVER grow a jax import — workers score rewards
+with pure numpy/python, nothing else.
+
+State protocol: :func:`pool_init` receives one pickled payload at pool
+start (cooked reference sets + the corpus n-gram document-frequency
+table — the big shared tables cross the process boundary exactly once);
+:func:`pool_score` then scores ``(video_idx, token_ids)`` row shards
+against it.  Scores are bit-identical to the parent's serial python
+scorer: same :func:`~cst_captioning_tpu.metrics.cider.ciderd_score_rows`
+loop, same deterministic ``cook_refs_vec`` vectors (docs/PARITY.md).
+"""
+
+from __future__ import annotations
+
+import pickle
+import time
+from typing import List, Sequence
+
+import numpy as np
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.metrics.cider import (
+    ciderd_score_rows,
+    cook_refs_vec,
+    precook,
+)
+
+
+def ids_until_end(row: Sequence[int]) -> List[int]:
+    """Candidate tokens: everything before the first PAD/EOS, skipping BOS
+    (sampled sequences never contain BOS, but encoded refs do)."""
+    out = []
+    for t in row:
+        t = int(t)
+        if t in (PAD_ID, EOS_ID):
+            break
+        if t == BOS_ID:
+            continue
+        out.append(t)
+    return out
+
+
+# Per-worker scoring state, installed once by pool_init at pool start.
+_WORKER_STATE: dict = {}
+
+
+def pool_init(payload: bytes) -> None:
+    global _WORKER_STATE
+    _WORKER_STATE = pickle.loads(payload)
+    # Per-video tf-idf reference vectors are cooked lazily in the worker
+    # (first batch touching the video) and memoized — cook_refs_vec is
+    # deterministic, so worker-cooked vectors are bit-identical to the
+    # parent's serial ones.
+    _WORKER_STATE["vec_cache"] = {}
+
+
+def pool_score(task) -> np.ndarray:
+    vids, token_ids = task
+    st = _WORKER_STATE
+    sim_ms = st.get("simulate_ms_per_row", 0.0)
+    if sim_ms > 0.0:
+        # Bench/test-only knob (see RewardPool): idle cost standing in
+        # for scorer work that does not contend with the device.
+        time.sleep(sim_ms * token_ids.shape[0] / 1e3)
+    cache = st["vec_cache"]
+    refs, df, lrl = st["cooked_refs"], st["doc_freq"], st["log_ref_len"]
+    weights = st["ref_weights"]
+    vec_rows, w_rows, cands = [], None if weights is None else [], []
+    for b in range(token_ids.shape[0]):
+        v = int(vids[b])
+        if v not in cache:
+            cache[v] = cook_refs_vec(refs[v], df, lrl)
+        vec_rows.append(cache[v])
+        if w_rows is not None:
+            w_rows.append(weights[v])
+        cands.append(precook(ids_until_end(token_ids[b])))
+    return ciderd_score_rows(
+        cands, vec_rows, df, lrl, use_d=st["use_d"],
+        ref_weights_rows=w_rows,
+    )

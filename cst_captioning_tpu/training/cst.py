@@ -29,6 +29,16 @@ Execution strategies (picked automatically):
   crossing payload is token ids + a float per sample).
 
 ``io_callback_supported()`` probes the backend once per process.
+
+Host scoring itself is scheduled OFF the device critical path (r9):
+``cfg.train.reward_workers`` shards rows across a persistent
+multiprocess :class:`~cst_captioning_tpu.training.rewards.RewardPool`
+(bit-identical scores), and ``cfg.train.overlap_rewards`` makes the
+split step feed rollout chunks to the scorer as they are harvested —
+scoring proceeds in the pool while the greedy-baseline decode still
+runs on device — blocking only at the PG-update dispatch, so step time
+approaches ``max(t_device, t_score) + t_update`` (docs/PERF.md r9,
+parity argument in docs/PARITY.md).
 """
 
 from __future__ import annotations
@@ -47,7 +57,11 @@ from jax.experimental import io_callback
 from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
 from cst_captioning_tpu.models.captioner import CaptionModel
 from cst_captioning_tpu.ops.losses import reward_criterion
-from cst_captioning_tpu.training.rewards import CiderDRewarder
+from cst_captioning_tpu.training.rewards import (
+    CiderDRewarder,
+    make_reward_scorer,
+)
+from cst_captioning_tpu.training.steps import PhaseClock
 
 log = logging.getLogger("cst_captioning_tpu.cst")
 
@@ -200,6 +214,19 @@ def make_cst_train_step(
         df_mode=cfg.data.idf_file or "corpus",
         weighted_refs=cfg.train.cst_weighted_reward,
     )
+    # Parallel reward pool (cfg.train.reward_workers > 1): rollout rows
+    # shard across a persistent multiprocess pool with the df/ref tables
+    # pickled once at start — bit-identical scores, ~1/W the host
+    # scoring wall time (training/rewards.py::RewardPool).  Every layout
+    # below consumes the same scorer surface (score_ids/submit/stream).
+    scorer = make_reward_scorer(
+        rewarder, max(0, getattr(cfg.train, "reward_workers", 0))
+    )
+    if scorer is not rewarder:
+        log.info(
+            "CST reward scoring: multiprocess pool with %d workers",
+            scorer.num_workers,
+        )
     if io_callback_supported():
         if layout != "auto":
             # The split layouts only exist for backends WITHOUT host
@@ -212,7 +239,7 @@ def make_cst_train_step(
                 "layouts apply only to backends without host callbacks)",
                 layout,
             )
-        return _make_one_graph_step(model, cfg, rewarder, mesh=mesh)
+        return _make_one_graph_step(model, cfg, scorer, mesh=mesh)
     use_pipeline = layout == "pipeline" or (
         layout == "auto"
         and dispatch_latency_ms() > _CHUNK_MAX_DISPATCH_MS
@@ -224,28 +251,31 @@ def make_cst_train_step(
             "next rollout; dispatch latency %.1f ms)",
             dispatch_latency_ms(),
         )
-        return _make_pipelined_step(model, cfg, rewarder)
+        return _make_pipelined_step(model, cfg, scorer)
     log.warning(
         "backend lacks io_callback support — using the split CST step "
         "(jitted rollout / host scoring / jitted update)"
     )
-    return _make_split_step(model, cfg, rewarder)
+    return _make_split_step(model, cfg, scorer)
 
 
 # ------------------------------------------------------- one-graph variant
 
-def _make_one_graph_step(model, cfg, rewarder, mesh=None) -> Callable:
+def _make_one_graph_step(model, cfg, scorer, mesh=None) -> Callable:
     S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
     gt_base = (
-        jnp.asarray(rewarder.gt_consensus())
+        jnp.asarray(scorer.gt_consensus())
         if baseline_kind == "gt_consensus"
         else None
     )
 
+    # With a RewardPool scorer the callback shards its rows across the
+    # worker processes — the io_callback's host window shrinks by ~1/W
+    # with bit-identical scores.
     def host_score(video_idx, tokens):
-        return rewarder.score_ids(video_idx, tokens).astype(np.float32)
+        return scorer.score_ids(video_idx, tokens).astype(np.float32)
 
     pg_logits_sharding = None
     if mesh is not None:
@@ -409,7 +439,7 @@ _CHUNK_MAX_DISPATCH_MS = 5.0
 
 # ------------------------------------------------------- pipelined variant
 
-def _make_pipelined_step(model, cfg, rewarder) -> Callable:
+def _make_pipelined_step(model, cfg, scorer) -> Callable:
     """Software-pipelined split step for high-dispatch-latency (tunneled)
     runtimes — VERDICT r3 #3's dispatch-tax attack.
 
@@ -480,15 +510,17 @@ def _make_pipelined_step(model, cfg, rewarder) -> Callable:
     phase_ms: dict = {}
 
     gt_base_np = (
-        rewarder.gt_consensus() if baseline_kind == "gt_consensus" else None
+        scorer.gt_consensus() if baseline_kind == "gt_consensus" else None
     )
 
     def _score(vid, tokens_np, greedy_np):
         vid_r = np.repeat(vid, S, axis=0)
-        rewards = rewarder.score_ids(vid_r, tokens_np).astype(np.float32)
-        greedy_scores = (
-            rewarder.score_ids(vid, greedy_np) if need_greedy else None
-        )
+        # Submit rollout AND greedy scoring before the first wait: a
+        # pooled scorer works both concurrently across its processes.
+        pending = scorer.submit(vid_r, tokens_np)
+        g_pending = scorer.submit(vid, greedy_np) if need_greedy else None
+        rewards = pending.wait().astype(np.float32)
+        greedy_scores = g_pending.wait() if g_pending is not None else None
         return rewards, _baseline_from(
             rewards, greedy_scores, S, baseline_kind,
             gt_rows=None if gt_base_np is None else gt_base_np[vid],
@@ -565,6 +597,7 @@ def _make_pipelined_step(model, cfg, rewarder) -> Callable:
     train_step.reset = reset
     train_step.phase_ms = phase_ms
     train_step.layout = "pipeline"
+    train_step.scorer = scorer
     return train_step
 
 
@@ -576,7 +609,7 @@ def _chunk_count(requested: int, B: int) -> int:
     return k
 
 
-def _make_split_step(model, cfg, rewarder) -> Callable:
+def _make_split_step(model, cfg, scorer) -> Callable:
     """Two-phase CST step for backends without io_callback — with the
     host scorer pipelined against device compute (SURVEY.md §7 hard part
     #1: the scorer "must overlap with device compute").
@@ -589,6 +622,24 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
     params — only the rng stream differs from the unchunked dispatch,
     which K=1 reproduces bit-for-bit).
 
+    **Overlapped reward scheduling** (``cfg.train.overlap_rewards``):
+    the rollout decode and the greedy-baseline decode are already
+    dispatched as independent device computations; with overlap on, each
+    rollout chunk is FED to the scorer's stream the moment its tokens
+    are fetched — an async pool scorer (``train.reward_workers``) then
+    scores in its worker processes while the device still runs the
+    greedy decode — and the host blocks only once, right before the
+    PG-update dispatch.  Step time approaches
+    ``max(t_device, t_score) + t_update`` instead of the serial sum
+    (docs/PERF.md).  Overlap off reproduces the serial in-place scoring
+    schedule; both produce bit-identical rewards and updates
+    (docs/PARITY.md, pinned by tests/test_cst.py).
+
+    Per-call wall-time phases (dispatch / sample fetch / score / greedy
+    fetch / score wait / update) are recorded on ``train_step.phase_ms``
+    — the trainer folds their epoch means into the history entry and
+    TensorBoard.
+
     Chunking pays ~2K-1 EXTRA dispatches per step, so it only wins when
     per-dispatch latency is far below the scorer cost.  On a tunneled
     runtime (measured ~140 ms RTT here, vs a ~44 ms scorer) it LOSES
@@ -600,8 +651,9 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
     need_greedy = baseline_kind == "greedy"
+    overlap = bool(getattr(cfg.train, "overlap_rewards", True))
     gt_base_np = (
-        rewarder.gt_consensus() if baseline_kind == "gt_consensus" else None
+        scorer.gt_consensus() if baseline_kind == "gt_consensus" else None
     )
     k_requested = max(1, getattr(cfg.train, "cst_score_chunks", 1))
     # High-latency (tunneled) runtimes take the FUSED single-dispatch
@@ -671,8 +723,12 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             isinstance(x, jax.Array) and len(x.sharding.device_set) > 1
         )
 
+    clock = PhaseClock()
+    phase_ms: dict = {}
+
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
+        clock.start()
         vid = np.asarray(video_idx)
         B = vid.shape[0]
         # Chunk slices ignore any data-axis sharding: on a multi-device
@@ -724,43 +780,73 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
                 if need_greedy
                 else []
             )
+        clock.lap("dispatch_ms")
 
-        # Phase 2 — host scoring, pipelined: np.asarray(chunk c) blocks
-        # only on chunk c's dispatch; later chunks keep the device busy.
+        # Phase 2 — host scoring, streamed: np.asarray(chunk c) blocks
+        # only on chunk c's dispatch; later chunks (and the greedy
+        # baseline decode) keep the device busy.  With overlap on, each
+        # fetched chunk is fed to the scorer stream — a pooled scorer
+        # works it in other processes immediately — and the single
+        # blocking wait lands just before the update dispatch.
+        stream = scorer.stream() if overlap else None
         reward_parts = []
         for c, (tokens, mask) in enumerate(dispatched):
             lo, hi = bounds[c]
             vid_r = np.repeat(vid[lo:hi], S, axis=0)
-            reward_parts.append(
-                rewarder.score_ids(vid_r, np.asarray(tokens)).astype(
-                    np.float32
+            tokens_np = np.asarray(tokens)
+            clock.lap("sample_fetch_ms")
+            if stream is not None:
+                stream.feed(vid_r, tokens_np)
+            else:
+                reward_parts.append(
+                    scorer.score_ids(vid_r, tokens_np).astype(np.float32)
                 )
-            )
-        rewards = np.concatenate(reward_parts)
+            clock.lap("score_ms")
 
-        greedy_scores = (
-            np.concatenate([
-                rewarder.score_ids(
-                    vid[lo:hi], np.asarray(toks)
-                ).astype(np.float32)
-                for (lo, hi), toks in zip(bounds, greedy_parts)
-            ])
-            if baseline_kind == "greedy"
-            else None
+        greedy_pending = None
+        greedy_scores = None
+        if need_greedy:
+            greedy_np = []
+            for toks in greedy_parts:
+                greedy_np.append(np.asarray(toks))
+                clock.lap("greedy_fetch_ms")
+            if overlap:
+                greedy_pending = [
+                    scorer.submit(vid[lo:hi], toks)
+                    for (lo, hi), toks in zip(bounds, greedy_np)
+                ]
+            else:
+                greedy_scores = np.concatenate([
+                    scorer.score_ids(vid[lo:hi], toks).astype(np.float32)
+                    for (lo, hi), toks in zip(bounds, greedy_np)
+                ])
+            clock.lap("score_ms")
+
+        rewards = (
+            stream.finish() if stream is not None
+            else np.concatenate(reward_parts)
         )
+        if greedy_pending is not None:
+            greedy_scores = np.concatenate(
+                [p.wait() for p in greedy_pending]
+            ).astype(np.float32)
+        clock.lap("score_wait_ms")
         baseline = _baseline_from(
             rewards, greedy_scores, S, baseline_kind,
             gt_rows=None if gt_base_np is None else gt_base_np[vid],
         )
         advantage = rewards - baseline
 
-        # Phase 3 — one PG update over the full batch.
+        # Phase 3 — one PG update over the full batch (donated state:
+        # param/optimizer buffers are reused, not copied).
         state, loss, gnorm = update_fn(
             state, feats, feat_masks, category,
             tuple(t for t, _ in dispatched),
             tuple(m for _, m in dispatched),
             jnp.asarray(advantage),
         )
+        clock.lap("update_ms")
+        clock.commit(phase_ms)
         return state, {
             "loss": loss,
             "grad_norm": gnorm,
@@ -769,4 +855,7 @@ def _make_split_step(model, cfg, rewarder) -> Callable:
             "advantage": jnp.float32(advantage.mean()),
         }
 
+    train_step.phase_ms = phase_ms
+    train_step.layout = "split"
+    train_step.scorer = scorer
     return train_step

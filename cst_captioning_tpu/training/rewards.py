@@ -22,35 +22,30 @@ TPU-first design:
 
 from __future__ import annotations
 
+import atexit
 import math
-from typing import List, Optional, Sequence
+import multiprocessing
+import pickle
+from typing import List, Optional
 
 import numpy as np
 
-from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID, UNK_ID
+from cst_captioning_tpu.constants import UNK_ID
 from cst_captioning_tpu.data.datasets import CaptionDataset
 from cst_captioning_tpu.metrics.cider import (
     _CiderBase,
+    ciderd_score_rows,
     ciderd_score_vec,
     compute_doc_freq,
     cook_refs_vec,
     precook,
 )
+from cst_captioning_tpu.metrics.reward_worker import (  # noqa: F401
+    ids_until_end,  # canonical home: metrics/reward_worker.py (jax-free)
+    pool_init,
+    pool_score,
+)
 from cst_captioning_tpu.metrics.tokenizer import ptb_tokenize
-
-
-def ids_until_end(row: Sequence[int]) -> List[int]:
-    """Candidate tokens: everything before the first PAD/EOS, skipping BOS
-    (sampled sequences never contain BOS, but encoded refs do)."""
-    out = []
-    for t in row:
-        t = int(t)
-        if t in (PAD_ID, EOS_ID):
-            break
-        if t == BOS_ID:
-            continue
-        out.append(t)
-    return out
 
 
 class CiderDRewarder:
@@ -223,20 +218,200 @@ class CiderDRewarder:
         token_ids = np.asarray(token_ids)
         if self._native is not None:
             return self._native.score_ids(video_idx, token_ids)
-        out = np.zeros((token_ids.shape[0],), np.float32)
-        for b in range(token_ids.shape[0]):
-            vid = int(video_idx[b])
-            cand = precook(ids_until_end(token_ids[b]))
-            out[b] = ciderd_score_vec(
-                cand,
-                self._ref_vecs[vid],
-                self.doc_freq,
-                self.log_ref_len,
-                use_d=self.use_d,
-                ref_weights=(
-                    None
-                    if self._ref_weights is None
-                    else self._ref_weights[vid]
-                ),
+        vids = [int(v) for v in video_idx]
+        cands = [
+            precook(ids_until_end(token_ids[b]))
+            for b in range(token_ids.shape[0])
+        ]
+        return ciderd_score_rows(
+            cands,
+            [self._ref_vecs[v] for v in vids],
+            self.doc_freq,
+            self.log_ref_len,
+            use_d=self.use_d,
+            ref_weights_rows=(
+                None
+                if self._ref_weights is None
+                else [self._ref_weights[v] for v in vids]
+            ),
+        )
+
+    # Async surface (eager here): the CST step schedules scoring through
+    # submit()/stream() uniformly; the serial rewarder computes at the
+    # call site, the RewardPool overlaps it with device compute.
+    def submit(self, video_idx, token_ids) -> "PendingScores":
+        return PendingScores([self.score_ids(video_idx, token_ids)])
+
+    def stream(self) -> "RewardStream":
+        return RewardStream(self)
+
+
+class PendingScores:
+    """Handle for in-flight reward scoring.  ``wait()`` concatenates the
+    per-shard results in submission order — the order the serial scorer
+    would have produced — so async delivery cannot permute rows."""
+
+    def __init__(self, parts: list):
+        self._parts = parts
+
+    def wait(self) -> np.ndarray:
+        out = [
+            p.get() if hasattr(p, "get") else p for p in self._parts
+        ]
+        if not out:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(out).astype(np.float32, copy=False)
+
+
+class RewardStream:
+    """Streaming scorer front end: ``feed()`` accepts rollout token rows
+    as they are harvested from the device (chunk by chunk), ``finish()``
+    blocks once and returns the concatenated scores in feed order."""
+
+    def __init__(self, scorer):
+        self._scorer = scorer
+        self._pending: List[PendingScores] = []
+
+    def feed(self, video_idx, token_ids) -> None:
+        self._pending.append(self._scorer.submit(video_idx, token_ids))
+
+    def finish(self) -> np.ndarray:
+        out = [p.wait() for p in self._pending]
+        self._pending = []
+        if not out:
+            return np.zeros((0,), np.float32)
+        return np.concatenate(out)
+
+
+# ----------------------------------------------------- multiprocess pool
+
+class RewardPool:
+    """Persistent multiprocess CIDEr-D reward pool.
+
+    Wraps a python-backend :class:`CiderDRewarder`: rollout rows are
+    sharded contiguously across ``num_workers`` worker processes and the
+    per-shard results concatenated in order — BIT-IDENTICAL to serial
+    scoring, because rows are independent and the workers run the exact
+    same :func:`~cst_captioning_tpu.metrics.cider.ciderd_score_rows`
+    loop (docs/PARITY.md).  The corpus n-gram document-frequency table
+    and the cooked reference sets are pickled to the workers ONCE at
+    pool start; per call only the token rows cross the process boundary.
+
+    ``submit()`` returns a :class:`PendingScores` handle and
+    ``stream()`` a :class:`RewardStream` — the CST step feeds rollout
+    chunks as they come off the device and blocks only at the PG-update
+    dispatch, so host scoring hides under device decode time
+    (``training/cst.py``).
+
+    ``simulate_ms_per_row`` is a bench/test-only knob: an idle
+    ``time.sleep`` per row in the workers, modeling scorer cost that
+    does not contend with the accelerator (the ``tools/overlap_sim.py``
+    technique) on hosts too small to exhibit it — it never changes the
+    computed scores.
+    """
+
+    def __init__(
+        self,
+        rewarder: CiderDRewarder,
+        num_workers: int,
+        start_method: Optional[str] = None,
+        simulate_ms_per_row: float = 0.0,
+    ):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        self._inner = rewarder
+        self.num_workers = num_workers
+        self.backend = f"python-pool{num_workers}"
+        payload = pickle.dumps(
+            {
+                "cooked_refs": rewarder._cooked_refs,
+                "doc_freq": dict(rewarder.doc_freq),
+                "log_ref_len": rewarder.log_ref_len,
+                "use_d": rewarder.use_d,
+                "ref_weights": rewarder._ref_weights,
+                "simulate_ms_per_row": float(simulate_ms_per_row),
+            },
+            protocol=pickle.HIGHEST_PROTOCOL,
+        )
+        if start_method is None:
+            # forkserver: workers fork from a CLEAN spawn-created server
+            # process, never from this (jax-threaded) one — plain fork
+            # from a long-lived jax parent deadlocked reproducibly (a
+            # child can inherit a lock a jax thread held at fork time;
+            # the failure jax's os.fork RuntimeWarning describes).  The
+            # worker-side module is jax-free by construction
+            # (metrics/reward_worker.py), so the per-worker import cost
+            # is ~0.1 s, paid once at pool start.
+            methods = multiprocessing.get_all_start_methods()
+            start_method = (
+                "forkserver" if "forkserver" in methods else "spawn"
             )
-        return out
+        ctx = multiprocessing.get_context(start_method)
+        self._pool = ctx.Pool(
+            num_workers, initializer=pool_init, initargs=(payload,)
+        )
+        self._closed = False
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------- scoring
+    def _shards(self, video_idx, token_ids):
+        n = token_ids.shape[0]
+        k = min(self.num_workers, n)
+        bounds = np.linspace(0, n, k + 1).round().astype(int)
+        return [
+            (video_idx[lo:hi], token_ids[lo:hi])
+            for lo, hi in zip(bounds[:-1], bounds[1:])
+            if hi > lo
+        ]
+
+    def submit(self, video_idx, token_ids) -> PendingScores:
+        """Shard rows across the workers; returns immediately."""
+        video_idx = np.asarray(video_idx)
+        token_ids = np.asarray(token_ids)
+        return PendingScores([
+            self._pool.apply_async(pool_score, (shard,))
+            for shard in self._shards(video_idx, token_ids)
+        ])
+
+    def score_ids(self, video_idx, token_ids) -> np.ndarray:
+        return self.submit(video_idx, token_ids).wait()
+
+    def stream(self) -> RewardStream:
+        return RewardStream(self)
+
+    def gt_consensus(self) -> np.ndarray:
+        return self._inner.gt_consensus()
+
+    # ------------------------------------------------------------ lifecycle
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._pool.terminate()
+            self._pool.join()
+
+    def __enter__(self) -> "RewardPool":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def make_reward_scorer(
+    rewarder: CiderDRewarder, num_workers: int, **pool_kwargs
+):
+    """Wrap ``rewarder`` in a :class:`RewardPool` when it would help.
+
+    ``num_workers <= 1`` keeps the serial scorer; the native C++ backend
+    is already threaded internally, so pooling it would only add IPC.
+    """
+    if num_workers <= 1:
+        return rewarder
+    if rewarder.backend != "python":
+        import logging
+
+        logging.getLogger("cst_captioning_tpu.rewards").info(
+            "reward_workers=%d ignored: the %s scorer backend is already "
+            "parallel", num_workers, rewarder.backend,
+        )
+        return rewarder
+    return RewardPool(rewarder, num_workers, **pool_kwargs)

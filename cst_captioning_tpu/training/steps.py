@@ -10,6 +10,7 @@ optimizer, so no Python-side LR mutation exists.
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict
 
 import jax
@@ -23,6 +24,48 @@ from cst_captioning_tpu.ops.losses import weighted_cross_entropy
 
 class TrainState(train_state.TrainState):
     """flax TrainState (params, tx, opt_state, step) — no extra fields."""
+
+
+class PhaseClock:
+    """Per-step wall-time breakdown for host-driven train steps.
+
+    The split/pipelined CST layouts interleave device dispatches with
+    host reward scoring; knowing WHERE a step's wall time goes (sample
+    fetch vs host scoring vs exposed scoring stall vs update dispatch)
+    is what makes reward-scoring regressions visible in training logs
+    instead of only in bench runs.  Usage per step::
+
+        clock.start()
+        ... ; clock.lap("sample_fetch_ms")
+        ... ; clock.lap("score_ms")
+        clock.commit(into)   # rounds + writes phase dict, adds total_ms
+
+    ``lap(key)`` ACCUMULATES into ``key`` (call sites inside loops add
+    up), so one step's phases always sum to ``total_ms`` minus unlapped
+    gaps.  The dict written by ``commit`` is plain host floats — the
+    trainer averages them into the epoch entry and TensorBoard.
+    """
+
+    def __init__(self):
+        self._t0 = None
+        self._last = None
+        self._acc: Dict[str, float] = {}
+
+    def start(self) -> None:
+        self._t0 = self._last = time.perf_counter()
+        self._acc = {}
+
+    def lap(self, key: str) -> None:
+        now = time.perf_counter()
+        self._acc[key] = self._acc.get(key, 0.0) + (now - self._last) * 1e3
+        self._last = now
+
+    def commit(self, into: Dict[str, float]) -> Dict[str, float]:
+        total = (time.perf_counter() - self._t0) * 1e3
+        into.clear()
+        into.update({k: round(v, 3) for k, v in self._acc.items()})
+        into["total_ms"] = round(total, 3)
+        return into
 
 
 def make_lr_schedule(cfg_train, steps_per_epoch: int) -> optax.Schedule:

@@ -369,6 +369,12 @@ class Trainer:
         # Device scalars accumulated without forcing a host sync per step;
         # converted once at epoch end.
         acc: Dict[str, List[jax.Array]] = {}
+        # Host-side per-phase wall-time breakdown (split/pipelined CST
+        # steps expose ``phase_ms``): epoch means land in the history
+        # entry and TensorBoard as ``phase_*_ms``, so a reward-scoring
+        # regression shows up in training logs, not only in bench runs.
+        step_phases = getattr(self._train_step, "phase_ms", None)
+        phase_acc: Dict[str, List[float]] = {}
         t0 = time.time()
         nsteps = 0  # steps dispatched by THIS call (logging/throughput)
         self._epoch_steps_done = skip_steps
@@ -414,6 +420,9 @@ class Trainer:
             )
             for k, v in metrics.items():
                 acc.setdefault(k, []).append(v)
+            if step_phases:
+                for k, v in step_phases.items():
+                    phase_acc.setdefault(k, []).append(v)
             self._epoch_steps_done = i + 1
             nsteps += 1
             if cfg.train.nan_check and "loss" in metrics:
@@ -463,6 +472,8 @@ class Trainer:
         out.setdefault("train_loss", float("nan"))
         out["ss_prob"] = ss_prob
         out["steps_per_sec"] = nsteps / elapsed_s
+        for k, v in phase_acc.items():
+            out[f"phase_{k}"] = float(np.mean(v))
         return out
 
     # ---------------------------------------------------------- evaluation

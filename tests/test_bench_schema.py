@@ -1,0 +1,133 @@
+"""BENCH_*/MULTICHIP_* record schema validation (bench.validate_record):
+malformed rows — missing keys, bool-typed measured fields (ADVICE r5:
+bool subclasses int), non-numeric phase times — must fail loudly at the
+emit site, before they reach a driver artifact."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import validate_record  # noqa: E402
+
+
+def good_bench():
+    return {
+        "metric": "xe_train_throughput_msrvtt_resnet_c3d",
+        "value": 1.23,
+        "unit": "steps/sec/chip",
+        "vs_baseline": 1.1,
+        "extra": {
+            "bench_chunk": 60,
+            "beam_fused": False,          # flags may be bool
+            "cst_pipe_speedup": 1.34,
+            "serving_shape": "smoke",
+            "serving_sweep": {"continuous": {}},
+        },
+    }
+
+
+class TestBenchKind:
+    def test_good_record_passes(self):
+        rec = good_bench()
+        assert validate_record(rec) is rec
+
+    def test_null_value_allowed(self):
+        rec = good_bench()
+        rec["value"] = None
+        rec["vs_baseline"] = None
+        validate_record(rec)
+
+    @pytest.mark.parametrize(
+        "missing", ["metric", "value", "unit", "vs_baseline", "extra"]
+    )
+    def test_missing_required_key_fails(self, missing):
+        rec = good_bench()
+        del rec[missing]
+        with pytest.raises(ValueError, match=missing):
+            validate_record(rec)
+
+    def test_bool_value_fails(self):
+        """bool subclasses int — a True headline would count as a
+        measurement everywhere downstream (ADVICE r5)."""
+        rec = good_bench()
+        rec["value"] = True
+        with pytest.raises(ValueError, match="value"):
+            validate_record(rec)
+
+    def test_bool_measured_extra_fails(self):
+        rec = good_bench()
+        rec["extra"]["cst_pipe_serial_step_ms"] = True
+        with pytest.raises(ValueError, match="bool-typed"):
+            validate_record(rec)
+
+    def test_bool_vs_extra_fails(self):
+        rec = good_bench()
+        rec["extra"]["vs_baseline_matched_chunk"] = False
+        with pytest.raises(ValueError, match="bool-typed"):
+            validate_record(rec)
+
+    def test_non_dict_extra_fails(self):
+        rec = good_bench()
+        rec["extra"] = [1, 2]
+        with pytest.raises(ValueError, match="extra"):
+            validate_record(rec)
+
+    def test_string_value_fails(self):
+        rec = good_bench()
+        rec["value"] = "1.23"
+        with pytest.raises(ValueError, match="value"):
+            validate_record(rec)
+
+
+class TestMultichipKinds:
+    def test_partial_good(self):
+        rec = {
+            "dryrun_partial": {
+                "n_devices": 8,
+                "phases": {"build-main-mesh": {"s": 12.3, "mesh": {}}},
+            },
+            "elapsed_s": 13.0,
+        }
+        validate_record(rec, kind="multichip_partial")
+
+    def test_partial_missing_phase_time_fails(self):
+        rec = {
+            "dryrun_partial": {"phases": {"compile": {"loss": 1.0}}},
+            "elapsed_s": 3.0,
+        }
+        with pytest.raises(ValueError, match="compile"):
+            validate_record(rec, kind="multichip_partial")
+
+    def test_partial_bool_elapsed_fails(self):
+        rec = {
+            "dryrun_partial": {"phases": {}},
+            "elapsed_s": True,
+        }
+        with pytest.raises(ValueError, match="elapsed_s"):
+            validate_record(rec, kind="multichip_partial")
+
+    def test_stalled_good(self):
+        validate_record(
+            {
+                "dryrun_phase_stalled": "compile+5steps",
+                "phase_budget_s": 165.0,
+                "elapsed_s": 170.2,
+                "completed": {},
+            },
+            kind="multichip_stalled",
+        )
+
+    def test_stalled_unnamed_fails(self):
+        with pytest.raises(ValueError, match="name a phase"):
+            validate_record(
+                {"dryrun_phase_stalled": 3, "phase_budget_s": 1.0,
+                 "elapsed_s": 1.0},
+                kind="multichip_stalled",
+            )
+
+    def test_unknown_kind_fails(self):
+        with pytest.raises(ValueError, match="unknown record kind"):
+            validate_record(good_bench(), kind="nonsense")

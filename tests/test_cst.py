@@ -396,6 +396,91 @@ class TestSplitStep:
         state2, fm = t._train_step.flush(t.state)
         assert fm is None
 
+    @pytest.mark.parametrize("baseline", ["greedy", "scb"])
+    def test_overlap_on_off_fixed_seed_parity(
+        self, corpus, tmp_path, baseline, monkeypatch
+    ):
+        """The overlapped reward schedule (stream-fed pool scoring,
+        single wait at the update dispatch) is scheduling only: a
+        fixed-seed short CST run must produce IDENTICAL losses and
+        params with overlap on (pooled, 2 workers) vs off (serial
+        in-place scoring)."""
+        from cst_captioning_tpu.training import cst as cst_mod
+        from cst_captioning_tpu.training.rewards import RewardPool
+
+        cfg, model, _, run = split_setup(
+            corpus, tmp_path, baseline, cst_score_chunks=2
+        )
+        # Pin the PYTHON scorer on BOTH sides: the pool's parity
+        # contract is vs python serial scoring (the native C++ backend
+        # has its own float path and is never pooled —
+        # make_reward_scorer gates it out).
+        ds, _ = corpus
+        rewarder = CiderDRewarder(ds, backend="python")
+        monkeypatch.setattr(cst_mod, "dispatch_latency_ms", lambda: 0.0)
+        cfg.train.overlap_rewards = False
+        s_off, m_off = run.steps(
+            cst_mod._make_split_step(model, cfg, rewarder), 3
+        )
+        cfg.train.overlap_rewards = True
+        with RewardPool(rewarder, 2) as pool:
+            s_on, m_on = run.steps(
+                cst_mod._make_split_step(model, cfg, pool), 3
+            )
+        for a, b in zip(m_off, m_on):
+            for k in ("loss", "reward", "baseline", "advantage"):
+                assert float(a[k]) == float(b[k]), k
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            s_off.params,
+            s_on.params,
+        )
+
+    def test_split_step_records_phase_breakdown(
+        self, corpus, tmp_path, monkeypatch
+    ):
+        """Per-phase wall-time breakdown on train_step.phase_ms after a
+        step — the observability surface the trainer/bench consume."""
+        from cst_captioning_tpu.training import cst as cst_mod
+
+        cfg, model, rewarder, run = split_setup(
+            corpus, tmp_path, "greedy", cst_score_chunks=1
+        )
+        monkeypatch.setattr(cst_mod, "dispatch_latency_ms", lambda: 0.0)
+        step = cst_mod._make_split_step(model, cfg, rewarder)
+        assert step.layout == "split"
+        run(step)
+        for key in ("dispatch_ms", "sample_fetch_ms", "score_ms",
+                    "greedy_fetch_ms", "update_ms", "total_ms"):
+            assert key in step.phase_ms, step.phase_ms
+            assert step.phase_ms[key] >= 0.0
+        assert step.phase_ms["total_ms"] >= max(
+            v for k, v in step.phase_ms.items() if k != "total_ms"
+        )
+
+    def test_trainer_logs_phase_breakdown(
+        self, corpus, tmp_path, monkeypatch
+    ):
+        """End-to-end: a Trainer driving the split layout folds the
+        per-phase means into the epoch history entry (phase_*_ms keys),
+        so scoring regressions are visible in training logs."""
+        from cst_captioning_tpu.training import cst as cst_mod
+
+        ds, _ = corpus
+        monkeypatch.setattr(cst_mod, "io_callback_supported", lambda: False)
+        monkeypatch.setattr(cst_mod, "dispatch_latency_ms", lambda: 0.0)
+        cfg = cst_cfg(tmp_path, "scb", cst_split_layout="chunked")
+        cfg.train.max_epochs = 1
+        t = Trainer(cfg, train_ds=ds, val_ds=None,
+                    workdir=str(tmp_path / "phase_w"))
+        hist = t.fit()
+        e = hist["0"]
+        for key in ("phase_sample_fetch_ms", "phase_score_ms",
+                    "phase_update_ms", "phase_total_ms"):
+            assert key in e and np.isfinite(e[key]), e
+
     def test_chunk_count_divisor_fallback(self):
         from cst_captioning_tpu.training.cst import _chunk_count
 

@@ -178,6 +178,72 @@ class TestBatchIterator:
         assert same.shape == (20, 1)
 
 
+class TestPrefetch:
+    def _batches(self, n=3):
+        ds, _ = make_synthetic_dataset(num_videos=8, max_frames=6, seed=0)
+        it = BatchIterator(ds, batch_size=4, seq_per_img=2, max_frames=6,
+                           shuffle=False)
+        return list(it.epoch(0))[:1] * n
+
+    @staticmethod
+    def _prefetch_threads():
+        import threading
+
+        return [
+            t for t in threading.enumerate()
+            if t.name == "prefetch_to_device" and t.is_alive()
+        ]
+
+    def test_worker_exception_propagates(self):
+        """An assembly error mid-epoch must poison-pill through to the
+        consumer (not silently end the epoch short) and leave no live
+        prefetch thread behind."""
+        from cst_captioning_tpu.data.loader import prefetch_to_device
+
+        good = self._batches(2)
+
+        def gen():
+            yield good[0]
+            raise RuntimeError("h5 read exploded")
+
+        got = []
+        with pytest.raises(RuntimeError, match="h5 read exploded"):
+            for b in prefetch_to_device(gen()):
+                got.append(b)
+        assert len(got) == 1  # the batch before the crash still arrived
+        import time
+
+        deadline = time.monotonic() + 5.0
+        while self._prefetch_threads() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not self._prefetch_threads()
+
+    def test_early_close_joins_worker_thread(self):
+        """Abandoning the iterator mid-epoch (break/exception in the
+        consumer) must join the worker so it cannot linger holding
+        device-resident batches in the queue."""
+        from cst_captioning_tpu.data.loader import prefetch_to_device
+
+        batch = self._batches(1)[0]
+
+        def endless():
+            while True:
+                yield batch
+
+        it = prefetch_to_device(endless(), size=2)
+        next(it)
+        assert self._prefetch_threads()
+        it.close()  # GeneratorExit -> finally: stop, drain, join
+        assert not self._prefetch_threads()
+
+    def test_clean_epoch_joins_worker_thread(self):
+        from cst_captioning_tpu.data.loader import prefetch_to_device
+
+        out = list(prefetch_to_device(iter(self._batches(3))))
+        assert len(out) == 3
+        assert not self._prefetch_threads()
+
+
 class TestConsensusWeights:
     def test_consensus_prefers_agreeing_caption(self):
         toks = [

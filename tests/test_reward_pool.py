@@ -1,0 +1,158 @@
+"""Parallel CIDEr-D reward pool parity: pooled and streamed scoring must
+be BIT-IDENTICAL to serial scoring across worker counts and shard
+remainders (docs/PARITY.md — the pool shards an order-preserving,
+row-independent loop), including degenerate rows (empty hypothesis,
+all-EOS) and weighted references."""
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.constants import EOS_ID
+from cst_captioning_tpu.data import make_synthetic_dataset
+from cst_captioning_tpu.training.rewards import (
+    CiderDRewarder,
+    RewardPool,
+    make_reward_scorer,
+)
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    return make_synthetic_dataset(
+        num_videos=12, max_frames=6, max_words=10, seed=5
+    )
+
+
+@pytest.fixture(scope="module")
+def serial(corpus):
+    ds, _ = corpus
+    return CiderDRewarder(ds, backend="python")
+
+
+def make_rows(corpus, n_rows: int, L: int = 9):
+    """n_rows candidate rows: mostly reference prefixes (non-zero
+    scores), plus an empty-hypothesis row (all PAD) and an all-EOS row
+    when there is space for them."""
+    ds, vocab = corpus
+    rng = np.random.RandomState(7)
+    toks = np.zeros((n_rows, L), np.int32)
+    vids = rng.randint(0, len(ds), size=(n_rows,)).astype(np.int32)
+    for b in range(n_rows):
+        ref = ds.references(int(vids[b]))[b % 2].split()
+        ids = [vocab.word_to_idx[w] for w in ref][: L - 1]
+        toks[b, : len(ids)] = ids
+    if n_rows >= 2:
+        toks[0, :] = 0       # empty hypothesis: PAD from position 0
+        toks[1, :] = 0
+        toks[1, 0] = EOS_ID  # all-EOS row: terminates immediately
+    return vids, toks
+
+
+@pytest.mark.parametrize("workers", [1, 2])
+@pytest.mark.parametrize("n_rows", [1, 5, 8])
+def test_pool_bitexact_vs_serial(corpus, serial, workers, n_rows):
+    """Every (workers, rows) combination — including shard remainders
+    (5 rows over 2 workers) and fewer rows than workers — must
+    concatenate back to the exact serial scores."""
+    vids, toks = make_rows(corpus, n_rows)
+    want = serial.score_ids(vids, toks)
+    with RewardPool(serial, workers) as pool:
+        got = pool.score_ids(vids, toks)
+    assert got.dtype == np.float32
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("n_rows", [1, 5, 8, 13])
+def test_pool_bitexact_four_workers(corpus, serial, n_rows):
+    vids, toks = make_rows(corpus, n_rows)
+    want = serial.score_ids(vids, toks)
+    with RewardPool(serial, 4) as pool:
+        np.testing.assert_array_equal(pool.score_ids(vids, toks), want)
+
+
+def test_degenerate_rows_score_zero(corpus, serial):
+    """Empty-hypothesis and all-EOS rows reduce to a zero-length
+    candidate — score must be exactly 0 in both paths, not NaN."""
+    vids, toks = make_rows(corpus, 4)
+    want = serial.score_ids(vids, toks)
+    assert want[0] == 0.0 and want[1] == 0.0
+    with RewardPool(serial, 2) as pool:
+        got = pool.score_ids(vids, toks)
+    np.testing.assert_array_equal(got[:2], [0.0, 0.0])
+    np.testing.assert_array_equal(got, want)
+
+
+def test_stream_feed_order_preserved(corpus, serial):
+    """Uneven streamed chunks concatenate in feed order == the serial
+    scores of the concatenated rows."""
+    vids, toks = make_rows(corpus, 11)
+    want = serial.score_ids(vids, toks)
+    with RewardPool(serial, 2) as pool:
+        st = pool.stream()
+        for lo, hi in ((0, 3), (3, 4), (4, 11)):
+            st.feed(vids[lo:hi], toks[lo:hi])
+        np.testing.assert_array_equal(st.finish(), want)
+    # The serial rewarder's eager stream matches too (the overlap-off
+    # twin the split step uses when no pool is configured).
+    st = serial.stream()
+    st.feed(vids[:6], toks[:6])
+    st.feed(vids[6:], toks[6:])
+    np.testing.assert_array_equal(st.finish(), want)
+
+
+def test_submit_async_matches_sync(corpus, serial):
+    vids, toks = make_rows(corpus, 7)
+    want = serial.score_ids(vids, toks)
+    with RewardPool(serial, 2) as pool:
+        handles = [pool.submit(vids, toks) for _ in range(3)]
+        for h in handles:  # persistent pool, repeated async use
+            np.testing.assert_array_equal(h.wait(), want)
+    np.testing.assert_array_equal(serial.submit(vids, toks).wait(), want)
+
+
+def test_zero_rows(serial):
+    with RewardPool(serial, 2) as pool:
+        out = pool.score_ids(
+            np.zeros((0,), np.int32), np.zeros((0, 9), np.int32)
+        )
+    assert out.shape == (0,) and out.dtype == np.float32
+
+
+def test_weighted_refs_parity(corpus):
+    """Per-reference consensus weights must survive the pool boundary."""
+    ds, _ = corpus
+    rng = np.random.RandomState(3)
+    try:
+        ds.set_caption_weights({
+            ds.video_id(i): rng.uniform(
+                0.2, 2.0, size=len(ds.references(i))
+            ).astype(np.float32)
+            for i in range(len(ds))
+        })
+        rw = CiderDRewarder(ds, backend="python", weighted_refs=True)
+        vids, toks = make_rows(corpus, 8)
+        want = rw.score_ids(vids, toks)
+        with RewardPool(rw, 2) as pool:
+            np.testing.assert_array_equal(pool.score_ids(vids, toks), want)
+    finally:
+        ds._weight_override = None  # module-scoped fixture
+
+
+def test_gt_consensus_passthrough(corpus, serial):
+    with RewardPool(serial, 2) as pool:
+        np.testing.assert_array_equal(
+            pool.gt_consensus(), serial.gt_consensus()
+        )
+
+
+def test_make_reward_scorer_gating(corpus, serial):
+    """0/1 workers (and non-python backends) keep the serial scorer."""
+    assert make_reward_scorer(serial, 0) is serial
+    assert make_reward_scorer(serial, 1) is serial
+    scorer = make_reward_scorer(serial, 2)
+    try:
+        assert isinstance(scorer, RewardPool)
+        assert scorer.num_workers == 2
+    finally:
+        scorer.close()

@@ -150,6 +150,59 @@ class TestTrainerXE:
         assert len(hist) <= 3
 
 
+class TestBufferDonation:
+    def test_xe_and_cst_steps_donate_state(self, corpus, tmp_path):
+        """donate_argnums on the XE and CST (PG-update) steps: the
+        lowered computations must alias the donated TrainState buffers
+        into their outputs (``tf.aliasing_output`` in StableHLO) so
+        param/optimizer buffers are REUSED across steps instead of
+        copied — on accelerator backends this halves state memory
+        traffic; it can never change results (the aliased input is
+        dead after its last read, docs/PARITY.md)."""
+        from cst_captioning_tpu.data import BatchIterator
+        from cst_captioning_tpu.models import model_from_config
+        from cst_captioning_tpu.training import cst as cst_mod
+        from cst_captioning_tpu.training.rewards import CiderDRewarder
+        from cst_captioning_tpu.training.steps import (
+            create_train_state,
+            make_optimizer,
+            make_xe_train_step,
+        )
+
+        ds, _ = corpus
+        cfg = smoke_cfg(tmp_path)
+        cfg.data.max_seq_len = 11
+        cfg.train.train_mode = "cst"
+        cfg.train.cst_baseline = "scb"
+        cfg.train.cst_num_samples = 2
+        cfg.model.vocab_size = len(ds.vocab)
+        model = model_from_config(cfg)
+        it = BatchIterator(ds, batch_size=8, seq_per_img=2, max_frames=6,
+                           shuffle=False)
+        b = next(iter(it.epoch(0)))
+        tx = make_optimizer(cfg.train, 10)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, b._asdict()
+        )
+        rng = jax.random.PRNGKey(1)
+
+        xe = make_xe_train_step(model)
+        lowered = xe.lower(
+            state, b.feats, b.feat_masks, b.captions, b.weights, None,
+            b.video_idx, rng, 0.0,
+        )
+        assert "tf.aliasing_output" in lowered.as_text()
+
+        cst = cst_mod._make_one_graph_step(
+            model, cfg, CiderDRewarder(ds, backend="python")
+        )
+        lowered = cst.lower(
+            state, b.feats, b.feat_masks, b.captions, b.weights, None,
+            b.video_idx, rng, 0.0,
+        )
+        assert "tf.aliasing_output" in lowered.as_text()
+
+
 class TestCheckpoint:
     def test_roundtrip_and_warm_start(self, corpus, tmp_path):
         ds, _ = corpus
