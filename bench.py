@@ -36,6 +36,9 @@ to skip the
 packed-loader assembly bench, BENCH_CST_PIPE=0 to skip the paired
 serial-vs-pipelined CST reward-scheduling rows (subprocess CPU child;
 BENCH_CST_PIPE_BATCH / _ROLLOUTS / _WORKERS / _STEPS / _REPS size it),
+BENCH_CST_SLOT=0 to skip the paired padded-vs-slot CST rollout rows
+(subprocess CPU child; BENCH_CST_SLOT_BATCH / _ROLLOUTS / _L / _RNN /
+_EOS_BIAS / _BLOCK / _STEPS / _WARM size it),
 BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -108,12 +111,23 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
         # Measured-looking extras must not be bool-typed: a *_ms /
         # *_per_sec / *_frac / vs_* field is a measurement by contract.
         measured_suffixes = ("_ms", "_per_sec", "_per_sec_chip", "_s",
-                             "_frac", "_pct", "_ratio", "_speedup")
+                             "_frac", "_pct", "_ratio", "_speedup",
+                             "_steps_per_row", "_ticks")
         for k, v in rec["extra"].items():
             if isinstance(v, bool) and (
                 k.endswith(measured_suffixes) or k.startswith("vs_")
             ):
                 fail(f"measured extra {k!r} is bool-typed")
+        # CPU-host caveats are machine-readable, not prose: any
+        # *_host_cores field (cst_pipe_, serving_replicas_, cst_slot_,
+        # ...) must be a real core count.
+        for k, v in rec["extra"].items():
+            if k.endswith("_host_cores") and not (
+                _is_number(v) and v >= 1
+            ):
+                fail(
+                    f"{k!r} must be a positive core count, got {v!r}"
+                )
     elif kind == "multichip_partial":
         body = rec.get("dryrun_partial")
         if not isinstance(body, dict) or "phases" not in body:
@@ -693,6 +707,214 @@ def bench_cst_pipeline():
         tail = (r.stderr or r.stdout).strip().splitlines()
         raise RuntimeError(
             f"cst pipeline child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    return json.loads(lines[-1])
+
+
+def _bench_cst_slot_impl():
+    """Paired PADDED-vs-SLOT CST rollout rows on the CPU smoke shape
+    (ISSUE 6 acceptance): both rows run the slot-machinery CST step
+    (``training/cst.py::_make_slot_step``) with the row-keyed sampler —
+    the padded row with every row resident for the full ``L`` decode
+    steps (today's rollout cost), the slot row with rows exiting on EOS
+    and harvests streamed to the scorer.  The token matrices are
+    BIT-identical (row-keyed PRNG), so fixed-seed losses AND params are
+    bit-identical between the rows — ``cst_slot_param_delta`` /
+    ``cst_slot_loss_delta`` pin both at 0.0 in the record.
+
+    A third row measures today's DEFAULT rollout (``cst_rollout=
+    "scan"``: the fused-scan ``model.sample`` with the full-length PG
+    update) for the end-to-end ratio.
+
+    The decode really ends early because the smoke model's ``logit_b``
+    is EOS-biased by ``BENCH_CST_SLOT_EOS_BIAS`` (recorded, with the
+    resulting ``cst_slot_mean_len``): a randomly initialized smoke
+    model would never emit EOS (P ~ 1/V per step) and every layout
+    would pay the full L — the bias stands in for what a TRAINED
+    captioner does naturally (MSR-VTT E[len] ~9-12 vs L 28-30).
+
+    1-core-host caveat (the PR-4/PR-5 precedent): ``cst_slot_host_cores``
+    records the CPU context; on real accelerators the win follows the
+    E[len]/L arithmetic in docs/PERF.md r10 rather than the host's
+    fixed per-dispatch costs."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.constants import EOS_ID
+    from cst_captioning_tpu.data import (
+        BatchIterator,
+        make_synthetic_dataset,
+    )
+    from cst_captioning_tpu.models import model_from_config
+    from cst_captioning_tpu.training import cst as cst_mod
+    from cst_captioning_tpu.training.rewards import CiderDRewarder
+    from cst_captioning_tpu.training.steps import (
+        create_train_state,
+        make_optimizer,
+    )
+
+    B = int(os.environ.get("BENCH_CST_SLOT_BATCH", "16"))
+    S = int(os.environ.get("BENCH_CST_SLOT_ROLLOUTS", "4"))
+    L = int(os.environ.get("BENCH_CST_SLOT_L", "64"))
+    rnn = int(os.environ.get("BENCH_CST_SLOT_RNN", "192"))
+    bias = float(os.environ.get("BENCH_CST_SLOT_EOS_BIAS", "2.8"))
+    block = int(os.environ.get("BENCH_CST_SLOT_BLOCK", "2"))
+    steps = int(os.environ.get("BENCH_CST_SLOT_STEPS", "5"))
+    warm = int(os.environ.get("BENCH_CST_SLOT_WARM", "2"))
+    rows = B * S + B  # rollout rows + greedy-baseline rows
+
+    ds, vocab = make_synthetic_dataset(
+        num_videos=B * 2, max_frames=6, max_words=10, seed=11
+    )
+    cfg = get_preset("synthetic_smoke")
+    cfg.data.batch_size = B
+    cfg.data.seq_per_img = 2
+    cfg.data.max_frames = 6
+    cfg.data.max_seq_len = L
+    cfg.train.train_mode = "cst"
+    cfg.train.cst_baseline = "greedy"
+    cfg.train.cst_num_samples = S
+    cfg.model.rnn_size = rnn
+    cfg.model.vocab_size = len(vocab)
+    model = model_from_config(cfg)
+    it = BatchIterator(ds, batch_size=B, seq_per_img=2, max_frames=6,
+                       shuffle=False)
+    batch = next(iter(it.epoch(0)))
+    tx = make_optimizer(cfg.train, 10)
+    rewarder = CiderDRewarder(ds, backend="python")
+
+    def bias_eos(params):
+        p = dict(params)
+        pp = dict(p["params"])
+        lb = np.asarray(pp["logit_b"]).copy()
+        lb[EOS_ID] += bias
+        pp["logit_b"] = jnp.asarray(lb)
+        p["params"] = pp
+        return p
+
+    def build(layout, slots=0):
+        cfg_x = cfg.replace(**{
+            "train.cst_rollout": layout,
+            "train.cst_slot_count": slots,
+            "train.cst_slot_block_steps": block,
+        })
+        if layout == "scan":
+            step = cst_mod._make_split_step(model, cfg_x, rewarder)
+        else:
+            step = cst_mod._make_slot_step(model, cfg_x, rewarder, layout)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, batch._asdict()
+        )
+        return step, [state.replace(params=bias_eos(state.params))]
+
+    def sweep(step, box):
+        ts, m = [], None
+        for i in range(steps + warm):
+            k = jax.random.fold_in(jax.random.PRNGKey(5), i)
+            t0 = time.perf_counter()
+            box[0], m = step(
+                box[0], batch.feats, batch.feat_masks, batch.captions,
+                batch.weights, None, batch.video_idx, k, 0.0,
+            )
+            float(m["loss"])
+            if i >= warm:
+                ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2], m
+
+    cst_mod.dispatch_latency_ms.cache_clear()
+    results, states, last_loss, stats = {}, {}, {}, {}
+    for name, layout, slots in (
+        ("scan", "scan", 0),
+        ("padded", "padded", 0),
+        ("slot", "slot", rows),
+    ):
+        step, box = build(layout, slots)
+        t, m = sweep(step, box)
+        results[name] = t
+        states[name] = box[0]
+        last_loss[name] = float(m["loss"])
+        if name == "slot":
+            stats = dict(step.rollout_stats)
+
+    # Parity pin: same row-keyed tokens -> bit-identical params/losses.
+    param_delta = float(
+        max(
+            jax.tree.leaves(
+                jax.tree.map(
+                    lambda a, b: jnp.max(jnp.abs(
+                        a.astype(jnp.float32) - b.astype(jnp.float32)
+                    )),
+                    states["padded"].params, states["slot"].params,
+                )
+            )
+        )
+    )
+    loss_delta = abs(last_loss["padded"] - last_loss["slot"])
+
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    return {
+        "cst_slot_host_cores": cores,
+        "cst_slot_rows": rows,
+        "cst_slot_L": L,
+        "cst_slot_block_steps": block,
+        "cst_slot_slots": stats.get("rollout_slots", rows),
+        "cst_slot_eos_bias": bias,
+        "cst_slot_mean_len": stats.get("rollout_mean_len"),
+        # Decode-step accounting (ISSUE 6 satellite): steps each row
+        # actually paid, plus the device tick/step totals per CST step.
+        "cst_rollout_steps_per_row": stats.get("rollout_steps_per_row"),
+        "cst_slot_harvest_ticks": stats.get("rollout_ticks"),
+        "cst_slot_decode_steps": stats.get("rollout_decode_steps"),
+        "cst_slot_update_trim_len": stats.get("update_trim_len"),
+        "cst_slot_padded_steps_per_row": float(L),
+        # The paired rows.
+        "cst_slot_scan_steps_per_sec": round(1.0 / results["scan"], 3),
+        "cst_slot_padded_steps_per_sec": round(
+            1.0 / results["padded"], 3
+        ),
+        "cst_slot_steps_per_sec": round(1.0 / results["slot"], 3),
+        "cst_slot_speedup": round(
+            results["padded"] / results["slot"], 3
+        ),
+        "cst_slot_speedup_vs_scan": round(
+            results["scan"] / results["slot"], 3
+        ),
+        "cst_slot_param_delta": param_delta,
+        "cst_slot_loss_delta": round(loss_delta, 9),
+    }
+
+
+def bench_cst_slot():
+    """Padded-vs-slot CST rollout pair (see :func:`_bench_cst_slot_impl`).
+    Always re-execs into a subprocess pinned to the in-process CPU
+    backend — the comparison targets the smoke shape by design and must
+    run in degraded mode too (the bench_cst_pipeline precedent)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CST_SLOT_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"cst slot child rc={r.returncode}: "
             f"{tail[-1] if tail else 'no output'}"
         )
     return json.loads(lines[-1])
@@ -1673,6 +1895,14 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["cst_pipe_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_CST_SLOT", "1") == "1":
+        # Paired padded-vs-slot CST rollout rows (subprocess on the
+        # in-process CPU backend; degraded-mode safe like cst_pipe).
+        try:
+            extra.update(bench_cst_slot())
+        except Exception as e:  # noqa: BLE001
+            extra["cst_slot_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if ok and os.environ.get("BENCH_DECODE", "1") == "1":
         try:
             extra.update(bench_decode())
@@ -1753,6 +1983,11 @@ if __name__ == "__main__":
         # sitecustomize platform pin can't win.
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_cst_pipeline_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_CST_SLOT_CHILD") == "1":
+        # Re-exec'd padded-vs-slot CST rollout child (bench_cst_slot).
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_cst_slot_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_REPLICA_CHILD") == "1":
         # Re-exec'd replica-sweep child (bench_serving_replicas): the

@@ -146,6 +146,33 @@ class TrainConfig:
     # scoring; ignored when the native C++ scorer (already threaded) is
     # built.
     reward_workers: int = 0
+    # CST rollout decode layout:
+    #   scan   — the classic fused-scan rollout (model.sample): every
+    #            row pads to max_seq_len inside one jitted graph.
+    #   slot   — the serving slot machinery reused in training
+    #            (training/cst.py::SlotRollout via decoding/core.py):
+    #            sampled-rollout and greedy-baseline rows occupy
+    #            persistent device slots, exit on EOS, and stream to
+    #            the reward scorer as they are harvested — total decode
+    #            cost ~ sum(row lengths) instead of rows x L
+    #            (docs/PERF.md r10).  Sampling is row-keyed
+    #            (fold_in(fold_in(rng, row_id), t)), so slot position /
+    #            admission order cannot change any sampled token.
+    #   padded — the slot path's bit-twin with every row resident for
+    #            the full L steps (bench baseline; same row-keyed
+    #            stream, bit-identical losses/params to "slot").
+    # NOTE: "scan" and the slot layouts draw from different PRNG
+    # streams (batch-threefry vs row-keyed) — same policy distribution,
+    # different trajectories (docs/PARITY.md).
+    cst_rollout: str = "scan"
+    # Decode slots for cst_rollout="slot" (rows, 1 row/slot).  0 = a
+    # quarter of the rollout rows (>= 8), so freed slots keep refilling
+    # while stragglers run.
+    cst_slot_count: int = 0
+    # Device decode steps per jitted slot-rollout call (>= 1) — the
+    # serving slot_block_steps knob: amortizes dispatch overhead at the
+    # price of harvest granularity (frozen rows ride at zero cost).
+    cst_slot_block_steps: int = 1
     # Overlapped reward scheduling in the split CST step: feed rollout
     # chunks to the scorer the moment their tokens are fetched (scoring
     # proceeds in pool workers while the greedy-baseline decode still

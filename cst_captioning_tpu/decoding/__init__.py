@@ -7,6 +7,18 @@ beam dimension — no dynamic shapes, runs under ``jit``/``pjit``
 (SURVEY.md §7 hard part #2).
 """
 
+# core first: models.captioner imports decoding.core, which runs this
+# __init__ — beam (below) must not re-enter a partially-built captioner.
+from cst_captioning_tpu.decoding.core import (  # noqa: F401
+    CoreState,
+    DecodeState,
+    decode_step,
+    get_backend,
+    init_core,
+    load_backends,
+    register_backend,
+    row_sample_fn,
+)
 from cst_captioning_tpu.decoding.beam import (  # noqa: F401
     BeamResult,
     beam_search,

@@ -27,18 +27,22 @@ token-exact at float32 (docs/PARITY.md records the tie-order contract).
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Tuple
+from typing import TYPE_CHECKING, Callable, NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
-from cst_captioning_tpu.models.captioner import (
-    CaptionModel,
-    warn_fused_decline,
+from cst_captioning_tpu.constants import PAD_ID
+from cst_captioning_tpu.decoding.core import (
+    NEG_INF,
+    all_done,
+    decode_step,
+    init_core,
+    register_backend,
 )
 
-NEG_INF = -1e30
+if TYPE_CHECKING:  # annotation-only: avoids the captioner import cycle
+    from cst_captioning_tpu.models.captioner import CaptionModel
 
 
 class BeamResult(NamedTuple):
@@ -111,7 +115,7 @@ def fused_beam_engaged(
 
 
 def beam_search(
-    model: CaptionModel,
+    model: "CaptionModel",
     params,
     feats,
     feat_masks,
@@ -135,6 +139,8 @@ def beam_search(
         )
         return finalize_beams(seqs, scores, length_normalize)
     if getattr(model, "use_pallas_beam", False):
+        from cst_captioning_tpu.models.captioner import warn_fused_decline
+
         warn_fused_decline("use_pallas_beam", reason)
     state, cache = model.apply(
         params, feats, feat_masks, category, method="init_decode"
@@ -173,10 +179,14 @@ def beam_search_from_state(
     relative order of equal-score beams), and :func:`finalize_beams`
     sorts best-first with a stable argsort either way, so skipping those
     steps cannot change any output (pinned by
-    tests/test_serving.py::test_beam_early_exit_parity)."""
+    tests/test_serving.py::test_beam_early_exit_parity).
+
+    The per-step recurrence itself lives in ``decoding/core.py``
+    (:func:`~cst_captioning_tpu.decoding.core.decode_step`) — this
+    function owns only the beam expansion, the loop, and the finalize
+    epilogue."""
     K = beam_size
     B = state.h.shape[1]
-    V = model.vocab_size
 
     # Expand every per-video tensor to the flat (B*K) beam axis.
     state = state._replace(
@@ -184,70 +194,44 @@ def beam_search_from_state(
     )
     cache = jax.tree.map(lambda x: jnp.repeat(x, K, axis=0), cache)
 
-    seqs0 = jnp.full((B, K, max_len), PAD_ID, jnp.int32)
-    # Only beam 0 is live at t=0 (all beams start identical).
-    scores0 = jnp.where(
-        jnp.arange(K)[None, :] == 0, 0.0, NEG_INF
-    ) * jnp.ones((B, 1))
-    finished0 = jnp.zeros((B, K), bool)
-    tokens0 = jnp.full((B * K,), BOS_ID, jnp.int32)
+    def step_logits(st, tokens):
+        return model.apply(
+            params, st, cache, tokens, method="decode_logits"
+        )  # float32 decode-policy logits (B*K, V)
 
-    def step(carry, t):
-        state, seqs, scores, finished, tokens = carry
-        state, logp = model.apply(
-            params, state, cache, tokens, method="decode_one"
-        )  # logp: (B*K, V) float32
-        logp = logp.reshape(B, K, V)
-        # decode_one already masks PAD/BOS out of the policy (EOS is the
-        # only terminator).
-        # Frozen finished beams: only PAD continuation, at zero cost.
-        pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
-        logp = jnp.where(finished[..., None], pad_only[None, None, :], logp)
-        total = scores[..., None] + logp                     # (B, K, V)
-        top_scores, top_flat = jax.lax.top_k(
-            total.reshape(B, K * V), K
-        )                                                     # (B, K)
-        parent = top_flat // V                                # (B, K)
-        tok = (top_flat % V).astype(jnp.int32)                # (B, K)
+    core0 = init_core(state, B, K, max_len, mode="beam")
 
-        batch_ix = jnp.arange(B)[:, None]
-        seqs = seqs[batch_ix, parent]                          # reorder history
-        seqs = jax.lax.dynamic_update_index_in_dim(
-            seqs, tok, t, axis=2
-        )
-        finished = finished[batch_ix, parent] | (tok == EOS_ID) | (tok == PAD_ID)
-        flat_parent = (batch_ix * K + parent).reshape(-1)      # (B*K,)
-        state = state._replace(
-            h=state.h[:, flat_parent], c=state.c[:, flat_parent]
-        )
-        # Finished beams feed EOS so the next-step embedding is defined.
-        next_tok = jnp.where(tok == PAD_ID, EOS_ID, tok).reshape(-1)
-        return (state, seqs, top_scores, finished, next_tok), None
+    def step(st, _):
+        return decode_step(step_logits, st, mode="beam"), None
 
     if early_exit:
-        def cond(carry):
-            t, _, _, _, finished, _ = carry
-            return (t < max_len) & ~jnp.all(finished)
-
-        def body(carry):
-            t, state, seqs, scores, finished, tokens = carry
-            (state, seqs, scores, finished, tokens), _ = step(
-                (state, seqs, scores, finished, tokens), t
-            )
-            return (t + 1, state, seqs, scores, finished, tokens)
-
-        (_, state, seqs, scores, finished, _) = jax.lax.while_loop(
-            cond,
-            body,
-            (jnp.int32(0), state, seqs0, scores0, finished0, tokens0),
+        st = jax.lax.while_loop(
+            lambda st: (st.step[0] < max_len) & ~all_done(st),
+            lambda st: step(st, None)[0],
+            core0,
         )
     else:
-        (state, seqs, scores, finished, _), _ = jax.lax.scan(
-            step,
-            (state, seqs0, scores0, finished0, tokens0),
-            jnp.arange(max_len),
-        )
-    return finalize_beams(seqs, scores, length_normalize)
+        st, _ = jax.lax.scan(step, core0, None, length=max_len)
+    return finalize_beams(st.seqs, st.scores, length_normalize)
+
+
+def _scan_beam_runner(ctx):
+    """Registry runner: the reference scan-path beam decode."""
+    import numpy as np
+
+    r = beam_search(
+        ctx.make_model(), ctx.params, ctx.feats, ctx.masks,
+        category=ctx.category, beam_size=ctx.beam_size,
+        max_len=ctx.max_len,
+    )
+    return {
+        "tokens": np.asarray(r.all_tokens[:, 0]),
+        "scores": np.asarray(r.all_scores[:, 0]),
+        "all_tokens": np.asarray(r.all_tokens),
+    }
+
+
+register_backend("scan_beam", _scan_beam_runner, kind="beam")
 
 
 def make_beam_search_fn(

@@ -1,0 +1,332 @@
+"""The ONE autoregressive decode step — every consumer imports it here.
+
+Before this module the repo carried four copies of the same per-step
+decode recurrence: the eval scan beam (``decoding/beam.py``), the fused
+Pallas beam (``ops/pallas_beam.py``), the fused sampler
+(``ops/pallas_sampler.py``) and the serving slot decoder
+(``serving/slots.py``) — exactly the drift hazard the portable-O(1)-
+caching line of work (PAPERS.md, arXiv:2603.09555) warns about: a fix
+or kernel improvement in one copy silently misses the other three.
+This module is the consolidation:
+
+* :class:`DecodeState` — the autoregressive (h, c) carry (moved here
+  from ``models/captioner.py``, which re-exports it).
+* :class:`CoreState` — the full decode-loop carry shared by every
+  XLA-path consumer: LSTM state, hypothesis/token buffers, beam
+  scores, finished flags, per-row write positions, optional rng.
+* :func:`decode_step` — THE per-step math, in three modes:
+  ``beam`` (top-K over score+logp with parent gather and EOS freeze),
+  ``greedy`` (argmax) and ``sample`` (temperature-scaled multinomial
+  with a pluggable noise source).  ``decoding/beam.py``,
+  ``serving/slots.py``, ``CaptionModel._sample_from_cache`` and the
+  CST ``SlotRollout`` (``training/cst.py``) all drive their loops
+  through this function; a grep-guard test
+  (tests/test_decode_core.py) fails the build if a new module
+  re-implements the recurrence instead of importing it.
+* a **backend registry**: every decode implementation — scan or fused
+  Pallas kernel — registers a parity runner here, and ONE shared
+  harness (tests/test_decode_core.py) drives all of them through
+  identical inputs and pins token/score exactness against their
+  declared reference, replacing four bespoke per-backend parity
+  copies.
+
+The fused Pallas kernels keep their in-kernel recurrences (a Pallas
+body cannot call back into XLA ops) — they participate through the
+registry and the shared :func:`finalize` epilogue instead, and the
+grep guard allowlists their files explicitly.
+
+Write positions are PER-ROW counters (``CoreState.step``), not the
+shared scan index: offline loops advance all rows together (counter ==
+scan index, value-identical), while the slot consumers hold rows at
+different decode depths in one matrix.  That one generalization is
+what lets the same step serve batch-synchronous eval, continuous
+serving, and the slot-based CST rollout.
+
+Row-keyed sampling (:func:`row_sample_fn`): the CST slot rollout draws
+each row's token from ``fold_in(fold_in(rng, row_id), t)`` — the
+row's IDENTITY and its own decode position, never its slot index or
+admission order — so which slot a row lands in, and when, cannot
+change any sampled token (docs/PARITY.md "slot rollout invariance").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+
+NEG_INF = -1e30
+
+
+class DecodeState(NamedTuple):
+    """Autoregressive decoder carry: per-layer (h, c)."""
+
+    h: jax.Array  # (num_layers, B, H) compute dtype
+    c: jax.Array  # (num_layers, B, H) float32
+
+
+class CoreState(NamedTuple):
+    """Carry of the unified decode loop over G row groups of K rows
+    each (beam: K = beam width; greedy/sample: K = 1).  The flat row
+    axis is ``G*K``.  Optional leaves are ``None`` where a mode does
+    not use them (beam: ``lps``/``rng``; row modes: ``scores``)."""
+
+    state: DecodeState            # (layers, G*K, H) LSTM carry
+    seqs: jax.Array               # (G, K, L) int32 emitted tokens
+    scores: Optional[jax.Array]   # (G, K) f32 cumulative beam log-probs
+    lps: Optional[jax.Array]      # (G, K, L) f32 per-token log-probs
+    finished: jax.Array           # (G, K) bool
+    tokens: jax.Array             # (G*K,) int32 next-step input tokens
+    step: jax.Array               # (G,) int32 per-row write position
+    rng: Optional[jax.Array]      # PRNG carry (threefry sample stream)
+
+
+def init_core(
+    state: DecodeState,
+    G: int,
+    K: int,
+    L: int,
+    *,
+    mode: str,
+    rng: Optional[jax.Array] = None,
+    want_lps: bool = True,
+) -> CoreState:
+    """Fresh decode-loop carry: BOS inputs, PAD buffers, beam 0 live
+    (beam mode), per-row write position 0."""
+    if mode == "beam":
+        scores = (
+            jnp.where(jnp.arange(K)[None, :] == 0, 0.0, NEG_INF)
+            * jnp.ones((G, 1))
+        ).astype(jnp.float32)
+        lps = None
+        rng = None
+    else:
+        scores = None
+        lps = jnp.zeros((G, K, L), jnp.float32) if want_lps else None
+    return CoreState(
+        state=state,
+        seqs=jnp.full((G, K, L), PAD_ID, jnp.int32),
+        scores=scores,
+        lps=lps,
+        finished=jnp.zeros((G, K), bool),
+        tokens=jnp.full((G * K,), BOS_ID, jnp.int32),
+        step=jnp.zeros((G,), jnp.int32),
+        rng=rng,
+    )
+
+
+def decode_step(
+    step_logits: Callable,
+    st: CoreState,
+    *,
+    mode: str,
+    temperature: float = 1.0,
+    sample_fn: Optional[Callable] = None,
+) -> CoreState:
+    """One decode step over every row of ``st`` — the single
+    definition site of the per-step recurrence.
+
+    ``step_logits(state, tokens) -> (state, logits)`` is the model
+    hook: one decoder step returning float32 DECODE-POLICY logits
+    (PAD/BOS masked out — ``CaptionModel.mask_decode_logits``).
+
+    Modes:
+
+    * ``"beam"`` — the ``lax.top_k`` beam recurrence over
+      ``score + log_softmax(logits)`` with PAD-frozen finished beams,
+      parent gather of hypothesis/state, EOS/PAD finish, PAD→EOS feed.
+    * ``"greedy"`` — argmax of ``log_softmax(logits)``; finished rows
+      emit PAD at zero log-prob.
+    * ``"sample"`` — multinomial over ``logits / temperature``.  The
+      noise source is pluggable: ``sample_fn(scaled_logits, key, st)
+      -> (G,) int32`` (``key`` is the step's split of ``st.rng``, or
+      ``None`` when the carry holds no rng — row-keyed callers derive
+      their own keys from ``st.step`` and row identity).  ``None``
+      uses ``jax.random.categorical`` on ``st.rng`` — the legacy
+      threefry batch stream of ``CaptionModel._sample_from_cache``.
+
+    Every op is row-independent, so co-resident rows (and admission
+    order, in slot consumers) cannot change any row's numbers — the
+    PR-3 parity argument, now made once, here (docs/PARITY.md).
+    """
+    G, K, L = st.seqs.shape
+    write = jnp.arange(L)[None, :] == st.step[:, None]     # (G, L)
+
+    if mode == "beam":
+        state, logits = step_logits(st.state, st.tokens)
+        V = logits.shape[-1]
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(G, K, V)
+        # Frozen finished beams: only PAD continuation, at zero cost.
+        pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
+        logp = jnp.where(
+            st.finished[..., None], pad_only[None, None, :], logp
+        )
+        total = st.scores[..., None] + logp                 # (G, K, V)
+        top_scores, top_flat = jax.lax.top_k(
+            total.reshape(G, K * V), K
+        )                                                    # (G, K)
+        parent = top_flat // V                               # (G, K)
+        tok = (top_flat % V).astype(jnp.int32)               # (G, K)
+        g_ix = jnp.arange(G)[:, None]
+        seqs = st.seqs[g_ix, parent]                         # reorder history
+        seqs = jnp.where(write[:, None, :], tok[:, :, None], seqs)
+        finished = (
+            st.finished[g_ix, parent] | (tok == EOS_ID) | (tok == PAD_ID)
+        )
+        flat_parent = (g_ix * K + parent).reshape(-1)        # (G*K,)
+        state = state._replace(
+            h=state.h[:, flat_parent], c=state.c[:, flat_parent]
+        )
+        # Finished beams feed EOS so the next-step embedding is defined.
+        next_tok = jnp.where(tok == PAD_ID, EOS_ID, tok).reshape(-1)
+        return CoreState(
+            state=state, seqs=seqs, scores=top_scores, lps=st.lps,
+            finished=finished, tokens=next_tok,
+            step=jnp.minimum(st.step + 1, L), rng=st.rng,
+        )
+
+    if mode not in ("greedy", "sample"):
+        raise ValueError(f"unknown decode mode {mode!r}")
+    if K != 1:
+        raise ValueError(f"row modes decode K=1 rows per group, got K={K}")
+    rng = st.rng
+    key = None
+    if mode == "sample" and rng is not None:
+        rng, key = jax.random.split(rng)
+    state, logits = step_logits(st.state, st.tokens)
+    if mode == "greedy":
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)    # (G,)
+    else:
+        scaled = logits / jnp.asarray(temperature, jnp.float32)
+        logp = jax.nn.log_softmax(scaled, axis=-1)
+        if sample_fn is None:
+            nxt = jax.random.categorical(key, scaled).astype(jnp.int32)
+        else:
+            nxt = sample_fn(scaled, key, st).astype(jnp.int32)
+    tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+    valid = ~st.finished[:, 0]                               # live rows
+    out_tok = jnp.where(valid, nxt, PAD_ID)
+    out_lp = jnp.where(valid, tok_lp, 0.0)
+    finished = st.finished | ((nxt == EOS_ID) | (nxt == PAD_ID))[:, None]
+    # Feed EOS (not raw PAD) so the next-step input embedding is defined.
+    feed = jnp.where(out_tok == PAD_ID, EOS_ID, out_tok)
+    seqs = jnp.where(write[:, None, :], out_tok[:, None, None], st.seqs)
+    lps = st.lps
+    if lps is not None:
+        lps = jnp.where(write[:, None, :], out_lp[:, None, None], lps)
+    return CoreState(
+        state=state, seqs=seqs, scores=st.scores, lps=lps,
+        finished=finished, tokens=feed,
+        step=jnp.minimum(st.step + 1, L), rng=rng,
+    )
+
+
+def all_done(st: CoreState) -> jax.Array:
+    """Scalar bool: every row of every group has finished."""
+    return jnp.all(st.finished)
+
+
+def row_sample_fn(
+    base_rng: jax.Array,
+    row_id: jax.Array,
+    is_sample: Optional[jax.Array] = None,
+) -> Callable:
+    """Row-keyed multinomial noise for :func:`decode_step` sample mode:
+    row ``r`` at its own decode position ``t`` draws from
+    ``fold_in(fold_in(base_rng, row_id[r]), t)``.  The key depends on
+    the row's IDENTITY and position only — never its slot index,
+    admission tick, or which rows share the matrix — so the padded and
+    slot rollout layouts produce bit-identical tokens per row
+    (docs/PARITY.md "slot rollout invariance").
+
+    ``is_sample`` (optional, (G,) bool): rows marked False take the
+    greedy argmax instead — the CST greedy-baseline rows riding in the
+    same slot matrix as the multinomial rollout rows."""
+    def fn(scaled: jax.Array, key, st: CoreState) -> jax.Array:
+        del key  # carries no rng; keys derive from row identity
+        keys = jax.vmap(
+            lambda r, t: jax.random.fold_in(
+                jax.random.fold_in(base_rng, r), t
+            )
+        )(row_id, st.step)
+        drawn = jax.vmap(jax.random.categorical)(keys, scaled)
+        if is_sample is None:
+            return drawn.astype(jnp.int32)
+        greedy = jnp.argmax(scaled, axis=-1)
+        return jnp.where(is_sample, drawn, greedy).astype(jnp.int32)
+
+    return fn
+
+
+# ------------------------------------------------------ backend registry
+
+class ParityCtx(NamedTuple):
+    """Everything a registered backend runner needs to decode one fixed
+    batch: a model factory (flag overrides pick the backend variant),
+    shared params/inputs, and decode knobs.  Built once by the shared
+    parity harness (tests/test_decode_core.py)."""
+
+    make_model: Callable          # (**flag overrides) -> CaptionModel
+    params: Any
+    feats: Any
+    masks: Any
+    category: Any
+    beam_size: int
+    max_len: int
+    temperature: float
+    rng: Any                      # PRNGKey
+    video_idx: Any                # (B,) int32 (rollout backends)
+    repeat: int                   # rollouts/video (rollout backends)
+
+
+class Backend(NamedTuple):
+    """One registered decode implementation.  ``ref`` names the backend
+    whose tokens it must match EXACTLY (None = it IS a reference);
+    ``kind`` groups comparable output shapes: "beam" -> best tokens
+    (B, L) + scores (B,), "greedy" -> tokens (B, L) + per-token lps,
+    "rollout" -> the full (rows, L) CST rollout token matrix."""
+
+    name: str
+    kind: str
+    ref: Optional[str]
+    run: Callable                 # (ParityCtx) -> Dict[str, np.ndarray]
+
+
+_BACKENDS: Dict[str, Backend] = {}
+
+# Modules that register decode backends at import time; the parity
+# harness (and the single-definition-site guard) imports them all.
+_BACKEND_MODULES = (
+    "cst_captioning_tpu.decoding.beam",
+    "cst_captioning_tpu.models.captioner",
+    "cst_captioning_tpu.ops.pallas_beam",
+    "cst_captioning_tpu.ops.pallas_sampler",
+    "cst_captioning_tpu.serving.slots",
+    "cst_captioning_tpu.training.cst",
+)
+
+
+def register_backend(
+    name: str, run: Callable, *, kind: str, ref: Optional[str] = None
+) -> None:
+    if kind not in ("beam", "greedy", "rollout"):
+        raise ValueError(f"unknown backend kind {kind!r}")
+    _BACKENDS[name] = Backend(name=name, kind=kind, ref=ref, run=run)
+
+
+def get_backend(name: str) -> Backend:
+    return _BACKENDS[name]
+
+
+def load_backends() -> List[str]:
+    """Import every consumer module (each registers its backends at
+    import bottom) and return the registered names, sorted."""
+    import importlib
+
+    for mod in _BACKEND_MODULES:
+        importlib.import_module(mod)
+    return sorted(_BACKENDS)

@@ -43,6 +43,12 @@ from cst_captioning_tpu.constants import (  # noqa: F401  (re-exported)
     PAD_ID,
     UNK_ID,
 )
+from cst_captioning_tpu.decoding.core import (  # noqa: F401  (re-exported)
+    DecodeState,
+    all_done,
+    decode_step,
+    init_core,
+)
 from cst_captioning_tpu.ops.rnn import (
     LSTMWeights,
     lstm_bias_init,
@@ -70,11 +76,8 @@ class SampleOutput(NamedTuple):
     mask: jax.Array      # (B, L) float32 — 1 up to and including the end token
 
 
-class DecodeState(NamedTuple):
-    """Autoregressive decoder carry: per-layer (h, c)."""
-
-    h: jax.Array  # (num_layers, B, H) compute dtype
-    c: jax.Array  # (num_layers, B, H) float32
+# DecodeState lives in decoding/core.py (the unified decode runtime)
+# and is re-exported above for the many existing importers.
 
 
 class DecodeCache(NamedTuple):
@@ -577,15 +580,24 @@ class CaptionModel(nn.Module):
             feats, feat_masks, category
         )
 
+    def decode_logits(
+        self, state: DecodeState, cache: DecodeCache, tokens: jax.Array
+    ) -> Tuple[DecodeState, jax.Array]:
+        """One decode step → (new state, float32 decode-policy LOGITS
+        (B, V), PAD/BOS masked out) — the model hook the unified decode
+        core (``decoding/core.py::decode_step``) drives; each mode
+        applies its own log_softmax/temperature on top."""
+        state, h_top = self._step(state, cache, tokens)
+        return state, self.mask_decode_logits(
+            self._logits(h_top), self.decode_suppress_unk
+        )
+
     def decode_one(
         self, state: DecodeState, cache: DecodeCache, tokens: jax.Array
     ) -> Tuple[DecodeState, jax.Array]:
         """One decode step → (new state, float32 log-probs (B, V)) under
         the decode policy (PAD/BOS masked out)."""
-        state, h_top = self._step(state, cache, tokens)
-        logits = self.mask_decode_logits(
-            self._logits(h_top), self.decode_suppress_unk
-        )
+        state, logits = self.decode_logits(state, cache, tokens)
         return state, jax.nn.log_softmax(logits, axis=-1)
 
     def sample(
@@ -599,13 +611,19 @@ class CaptionModel(nn.Module):
         greedy: bool = True,
         temperature: float = 1.0,
         repeat: int = 1,
+        early_exit: bool = True,
     ) -> SampleOutput:
-        """Autoregressive decode under ``jit``: exactly ``max_len`` steps,
+        """Autoregressive decode under ``jit``: up to ``max_len`` steps,
         finished sequences emit PAD with zero log-prob (fixed shapes — no
-        dynamic control flow).  ``greedy=True`` is the SCST baseline path;
-        ``greedy=False`` is the multinomial rollout (temperature-scaled),
-        with log-probs taken from the same scaled distribution the token was
-        drawn from, as REINFORCE requires.
+        data-dependent output shapes).  ``greedy=True`` is the SCST
+        baseline path; ``greedy=False`` is the multinomial rollout
+        (temperature-scaled), with log-probs taken from the same scaled
+        distribution the token was drawn from, as REINFORCE requires.
+
+        ``early_exit`` (default True): stop the loop once every row has
+        finished — the same all-rows-finished ``lax.while_loop`` the
+        scan beam got in PR 3, output-identical to the full-length scan
+        (see :meth:`_sample_from_cache`).
 
         ``repeat``: rollouts per video (CST_MS) — the projected cache is
         tiled after the feature projections, so S rollouts cost S x the
@@ -617,7 +635,7 @@ class CaptionModel(nn.Module):
             state = self._init_state(cache.ctx_static.shape[0])
         return self._sample_from_cache(
             state, cache, rng=rng, max_len=max_len, greedy=greedy,
-            temperature=temperature,
+            temperature=temperature, early_exit=early_exit,
         )
 
     def sample_with_baseline(
@@ -631,6 +649,7 @@ class CaptionModel(nn.Module):
         temperature: float = 1.0,
         repeat: int = 1,
         with_greedy: bool = True,
+        early_exit: bool = True,
     ) -> Tuple[SampleOutput, Optional[SampleOutput]]:
         """Multinomial rollout (``repeat`` per video) plus the optional
         greedy-baseline decode sharing ONE feature encode.  The CST step
@@ -647,12 +666,13 @@ class CaptionModel(nn.Module):
         )
         rollout = self._sample_from_cache(
             rstate, rcache, rng=rng, max_len=max_len, greedy=False,
-            temperature=temperature,
+            temperature=temperature, early_exit=early_exit,
         )
         if not with_greedy:
             return rollout, None
         greedy = self._sample_from_cache(
-            state0, cache, max_len=max_len, greedy=True
+            state0, cache, max_len=max_len, greedy=True,
+            early_exit=early_exit,
         )
         return rollout, greedy
 
@@ -666,6 +686,7 @@ class CaptionModel(nn.Module):
         greedy: bool = True,
         temperature: float = 1.0,
         zero_state: bool = True,
+        early_exit: bool = True,
     ) -> SampleOutput:
         """``zero_state``: both public callers (sample /
         sample_with_baseline) pass a fresh ``_init_state``, which the
@@ -712,44 +733,45 @@ class CaptionModel(nn.Module):
                     f"F={cache.att_proj.shape[1]} fails sampler_shapes_ok",
                 )
 
-        def step(carry, _):
-            state, tok, finished, key = carry
-            key, k = jax.random.split(key)
-            state, h_top = self._step(state, cache, tok)
-            logits = self.mask_decode_logits(
+        # The per-step math is the unified decode core's row mode
+        # (decoding/core.py::decode_step) — the legacy threefry batch
+        # stream rides in the carry (``CoreState.rng``) and greedy
+        # ignores it.  ``early_exit`` swaps the fixed-length scan for
+        # an all-rows-finished while_loop: buffers start at PAD/0, so
+        # the steps it skips would only have re-written those exact
+        # values — output-identical (the PR-3 beam argument, pinned by
+        # tests/test_decode_core.py).
+        def step_logits(st, tok):
+            st, h_top = self._step(st, cache, tok)
+            return st, self.mask_decode_logits(
                 self._logits(h_top), self.decode_suppress_unk
             )
-            if greedy:
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)
-            else:
-                scaled = logits / jnp.asarray(temperature, jnp.float32)
-                logp = jax.nn.log_softmax(scaled, axis=-1)
-                nxt = jax.random.categorical(k, scaled).astype(jnp.int32)
-            tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
-            valid = ~finished                       # this slot is live
-            out_tok = jnp.where(valid, nxt, PAD_ID)
-            out_lp = jnp.where(valid, tok_lp, 0.0)
-            ended = (nxt == EOS_ID) | (nxt == PAD_ID)
-            finished = finished | ended
-            # Feed EOS (not raw PAD) back in so the next-step input embedding
-            # is well-defined even for finished rows.
-            feed = jnp.where(out_tok == PAD_ID, EOS_ID, out_tok)
-            return (state, feed, finished, key), (
-                out_tok,
-                out_lp,
-                valid.astype(jnp.float32),
+
+        mode = "greedy" if greedy else "sample"
+        core0 = init_core(
+            state, B, 1, max_len, mode=mode,
+            rng=None if greedy else rng,
+        )
+
+        def step(st):
+            return decode_step(
+                step_logits, st, mode=mode, temperature=temperature
             )
 
-        bos = jnp.full((B,), BOS_ID, jnp.int32)
-        fin0 = jnp.zeros((B,), bool)
-        _, (toks, lps, mask) = jax.lax.scan(
-            step, (state, bos, fin0, rng), None, length=max_len
-        )
+        if early_exit:
+            st = jax.lax.while_loop(
+                lambda st: (st.step[0] < max_len) & ~all_done(st),
+                step,
+                core0,
+            )
+        else:
+            st, _ = jax.lax.scan(
+                lambda c, _: (step(c), None), core0, None, length=max_len
+            )
         return SampleOutput(
-            tokens=jnp.swapaxes(toks, 0, 1),
-            logprobs=jnp.swapaxes(lps, 0, 1),
-            mask=jnp.swapaxes(mask, 0, 1),
+            tokens=st.seqs[:, 0, :],
+            logprobs=st.lps[:, 0, :],
+            mask=(st.seqs[:, 0, :] != PAD_ID).astype(jnp.float32),
         )
 
     def _fused_gx_static(self, cache: DecodeCache) -> jax.Array:
@@ -905,6 +927,26 @@ class CaptionModel(nn.Module):
                 **common,
             )
         return SampleOutput(tokens=toks, logprobs=lps, mask=mask)
+
+
+def _scan_greedy_runner(ctx):
+    """Registry runner: the reference scan-path greedy decode."""
+    import numpy as np
+
+    out = ctx.make_model().apply(
+        ctx.params, ctx.feats, ctx.masks, category=ctx.category,
+        max_len=ctx.max_len, greedy=True, method="sample",
+    )
+    return {
+        "tokens": np.asarray(out.tokens),
+        "lps": np.asarray(out.logprobs),
+        "mask": np.asarray(out.mask),
+    }
+
+
+from cst_captioning_tpu.decoding.core import register_backend  # noqa: E402
+
+register_backend("scan_greedy", _scan_greedy_runner, kind="greedy")
 
 
 def model_from_config(cfg, mesh=None) -> CaptionModel:

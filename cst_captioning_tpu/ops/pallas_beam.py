@@ -708,3 +708,30 @@ def attlstm_beam_scan(
         step, carry0, jnp.arange(T, dtype=jnp.int32)
     )
     return seqs, score.reshape(B, K)
+
+
+# ------------------------------------------------ parity-harness backend
+
+def _fused_beam_runner(ctx):
+    """Registry runner (decoding/core.py): the whole-recurrence fused
+    beam kernel through the same ``beam_search`` dispatch the scan
+    reference uses — only the model flag differs."""
+    from cst_captioning_tpu.decoding.beam import beam_search
+
+    r = beam_search(
+        ctx.make_model(use_pallas_beam=True), ctx.params, ctx.feats,
+        ctx.masks, category=ctx.category, beam_size=ctx.beam_size,
+        max_len=ctx.max_len,
+    )
+    return {
+        "tokens": np.asarray(r.all_tokens[:, 0]),
+        "scores": np.asarray(r.all_scores[:, 0]),
+        "all_tokens": np.asarray(r.all_tokens),
+    }
+
+
+from cst_captioning_tpu.decoding.core import register_backend  # noqa: E402
+
+register_backend(
+    "fused_beam", _fused_beam_runner, kind="beam", ref="scan_beam"
+)

@@ -697,3 +697,31 @@ def attlstm_sample_scan(
         jnp.swapaxes(lps, 0, 1),
         jnp.swapaxes(msk, 0, 1),
     )
+
+
+# ------------------------------------------------ parity-harness backend
+
+def _fused_sampler_runner(ctx):
+    """Registry runner (decoding/core.py): the whole-recurrence fused
+    sampler kernel, greedy mode — the deterministic surface it is
+    token-exact on vs the scan path (the multinomial stream differs by
+    construction, docs/PARITY.md)."""
+    import numpy as np
+
+    out = ctx.make_model(use_pallas_sampler=True).apply(
+        ctx.params, ctx.feats, ctx.masks, category=ctx.category,
+        max_len=ctx.max_len, greedy=True, method="sample",
+    )
+    return {
+        "tokens": np.asarray(out.tokens),
+        "lps": np.asarray(out.logprobs),
+        "mask": np.asarray(out.mask),
+    }
+
+
+from cst_captioning_tpu.decoding.core import register_backend  # noqa: E402
+
+register_backend(
+    "fused_sampler", _fused_sampler_runner, kind="greedy",
+    ref="scan_greedy",
+)

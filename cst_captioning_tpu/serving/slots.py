@@ -33,14 +33,15 @@ matrices the tick already returned.
 Parity argument (the bar: slot-decoded captions are token-exact vs the
 offline ``evaluation.py`` path, pinned by tests/test_serving.py):
 
-* The per-step math is lifted verbatim from ``decoding/beam.py``
-  (beam) / ``CaptionModel._sample_from_cache`` (greedy): same
-  ``decode_one`` apply, same PAD-freeze of finished beams, same
-  ``lax.top_k`` / argmax selection, same parent gather — only the batch
-  axis is the slot axis and the sequence-write position is the per-slot
-  step counter instead of the shared scan index.  Every op is
-  row-independent, so which OTHER requests share the matrix (or arrive
-  later — admission order) cannot change any row's numbers.
+* The per-step math IS the unified decode core — the very same
+  ``decoding/core.py::decode_step`` the offline scan beam
+  (``decoding/beam.py``) and ``CaptionModel._sample_from_cache`` drive:
+  same PAD-freeze of finished beams, same top-K / argmax selection,
+  same parent gather — only the batch axis is the slot axis and the
+  sequence-write position is the per-slot step counter instead of the
+  shared scan index.  Every op is row-independent, so which OTHER
+  requests share the matrix (or arrive later — admission order) cannot
+  change any row's numbers.
 * A finished slot that keeps riding (until harvest, or the remainder of
   a step block) is frozen exactly like the offline scan's finished
   beams: its only continuation is PAD at zero cost, a no-op on
@@ -91,12 +92,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
-from cst_captioning_tpu.decoding.beam import NEG_INF
-from cst_captioning_tpu.models.captioner import (
-    DecodeCache,
+from cst_captioning_tpu.constants import BOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.core import (
+    NEG_INF,
+    CoreState,
     DecodeState,
+    decode_step,
+    register_backend,
 )
+from cst_captioning_tpu.models.captioner import DecodeCache
 
 _log = logging.getLogger("cst_captioning_tpu.serving")
 
@@ -122,17 +126,13 @@ class TickHandle(NamedTuple):
 
 
 class SlotState(NamedTuple):
-    """Device-resident state of all S decode slots (flat row axis is
-    ``S*K``; per-slot axes are ``(S, K, ...)``)."""
+    """Device-resident state of all S decode slots: the unified decode
+    carry (``decoding/core.py::CoreState``, per-slot axes ``(S, K,
+    ...)``, flat row axis ``S*K``) plus the projected ``DecodeCache``
+    rows the step closes over."""
 
-    h: jax.Array          # (layers, S*K, H) compute dtype
-    c: jax.Array          # (layers, S*K, H) float32
+    core: CoreState       # seqs/scores/finished/tokens/step + (h, c)
     cache: DecodeCache    # leaves lead with S*K
-    seqs: jax.Array       # (S, K, L) int32 emitted tokens
-    scores: jax.Array     # (S, K) float32 beam log-probs
-    finished: jax.Array   # (S, K) bool
-    tokens: jax.Array     # (S*K,) int32 next-step input tokens
-    step: jax.Array       # (S,) int32 decode step per slot (clamped at L)
 
 
 class SlotDecoder:
@@ -205,85 +205,45 @@ class SlotDecoder:
         cache = jax.tree.map(
             lambda sds: jnp.zeros(sds.shape, sds.dtype), cache_shape
         )
-        st = SlotState(
-            h=jnp.zeros((model.num_layers, n, model.rnn_size), cdt),
-            c=jnp.zeros((model.num_layers, n, model.rnn_size), jnp.float32),
-            cache=cache,
+        core = CoreState(
+            state=DecodeState(
+                h=jnp.zeros((model.num_layers, n, model.rnn_size), cdt),
+                c=jnp.zeros(
+                    (model.num_layers, n, model.rnn_size), jnp.float32
+                ),
+            ),
             seqs=jnp.full((S, K, L), PAD_ID, jnp.int32),
-            scores=jnp.zeros((S, K), jnp.float32),
+            scores=None if self.greedy else jnp.zeros((S, K), jnp.float32),
+            lps=None,
             # Empty slots ride as finished/step=L: done, frozen, harmless.
             finished=jnp.ones((S, K), bool),
             tokens=jnp.full((n,), BOS_ID, jnp.int32),
             step=jnp.full((S,), L, jnp.int32),
+            rng=None,
         )
+        st = SlotState(core=core, cache=cache)
         # Replica engines pin their slot matrix to their device so the
         # first tick doesn't silently run on the default device.
         dev = getattr(self.engine, "device", None)
         return st if dev is None else jax.device_put(st, dev)
 
     def _build_step(self) -> None:
-        model, S, K, L, V = self.model, self.S, self.K, self.L, self.V
-        greedy = self.greedy
+        model, K = self.model, self.K
+        mode = "greedy" if self.greedy else "beam"
 
         def step_once(params, st: SlotState) -> SlotState:
-            state = DecodeState(h=st.h, c=st.c)
-            state, logp = model.apply(
-                params, state, st.cache, st.tokens, method="decode_one"
-            )  # logp: (S*K, V) float32
-            write = (
-                jnp.arange(L)[None, :] == st.step[:, None]
-            )  # (S, L); all-False once step >= L
-            if greedy:
-                # CaptionModel._sample_from_cache greedy scan body,
-                # slot-indexed write position.
-                nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)  # (S,)
-                valid = ~st.finished[:, 0]
-                out_tok = jnp.where(valid, nxt, PAD_ID)
-                seqs = jnp.where(
-                    write[:, None, :], out_tok[:, None, None], st.seqs
+            # The per-step recurrence is the unified decode core
+            # (decoding/core.py::decode_step) — identical math to the
+            # offline scan paths, only the batch axis is the slot axis
+            # and write positions are the per-slot step counters.
+            def step_logits(state, tokens):
+                return model.apply(
+                    params, state, st.cache, tokens,
+                    method="decode_logits",
                 )
-                finished = st.finished | (
-                    (nxt == EOS_ID) | (nxt == PAD_ID)
-                )[:, None]
-                feed = jnp.where(out_tok == PAD_ID, EOS_ID, out_tok)
-                return st._replace(
-                    h=state.h, c=state.c, seqs=seqs, finished=finished,
-                    tokens=feed,
-                    step=jnp.minimum(st.step + 1, L),
-                )
-            # decoding/beam.py::beam_search_from_state scan body,
-            # slot-indexed write position.
-            logp = logp.reshape(S, K, V)
-            pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
-            logp = jnp.where(
-                st.finished[..., None], pad_only[None, None, :], logp
-            )
-            total = st.scores[..., None] + logp               # (S, K, V)
-            top_scores, top_flat = jax.lax.top_k(
-                total.reshape(S, K * V), K
-            )
-            parent = top_flat // V                             # (S, K)
-            tok = (top_flat % V).astype(jnp.int32)             # (S, K)
-            slot_ix = jnp.arange(S)[:, None]
-            seqs = st.seqs[slot_ix, parent]
-            seqs = jnp.where(write[:, None, :], tok[:, :, None], seqs)
-            finished = (
-                st.finished[slot_ix, parent]
-                | (tok == EOS_ID)
-                | (tok == PAD_ID)
-            )
-            flat_parent = (slot_ix * K + parent).reshape(-1)   # (S*K,)
-            next_tok = jnp.where(tok == PAD_ID, EOS_ID, tok).reshape(-1)
-            return SlotState(
-                h=state.h[:, flat_parent],
-                c=state.c[:, flat_parent],
-                cache=st.cache,
-                seqs=seqs,
-                scores=top_scores,
-                finished=finished,
-                tokens=next_tok,
-                step=jnp.minimum(st.step + 1, L),
-            )
+
+            core = decode_step(step_logits, st.core, mode=mode)
+            return SlotState(core=core, cache=st.cache)
 
         self._step_once = step_once
         self._scores0 = jnp.where(
@@ -313,42 +273,51 @@ class SlotDecoder:
                 ),
                 st.cache, rows_k,
             )
-            return SlotState(
-                h=jax.lax.dynamic_update_slice(
-                    st.h,
-                    jnp.zeros((model.num_layers, K, model.rnn_size), cdt),
-                    (jnp.int32(0), row0, jnp.int32(0)),
-                ),
-                c=jax.lax.dynamic_update_slice(
-                    st.c,
-                    jnp.zeros(
-                        (model.num_layers, K, model.rnn_size), jnp.float32
+            co = st.core
+            core = co._replace(
+                state=DecodeState(
+                    h=jax.lax.dynamic_update_slice(
+                        co.state.h,
+                        jnp.zeros(
+                            (model.num_layers, K, model.rnn_size), cdt
+                        ),
+                        (jnp.int32(0), row0, jnp.int32(0)),
                     ),
-                    (jnp.int32(0), row0, jnp.int32(0)),
+                    c=jax.lax.dynamic_update_slice(
+                        co.state.c,
+                        jnp.zeros(
+                            (model.num_layers, K, model.rnn_size),
+                            jnp.float32,
+                        ),
+                        (jnp.int32(0), row0, jnp.int32(0)),
+                    ),
                 ),
-                cache=cache,
                 seqs=jax.lax.dynamic_update_slice(
-                    st.seqs,
+                    co.seqs,
                     jnp.full((1, K, L), PAD_ID, jnp.int32),
                     (slot, jnp.int32(0), jnp.int32(0)),
                 ),
-                scores=jax.lax.dynamic_update_slice(
-                    st.scores, scores0, (slot, jnp.int32(0))
+                scores=(
+                    None if co.scores is None
+                    else jax.lax.dynamic_update_slice(
+                        co.scores, scores0, (slot, jnp.int32(0))
+                    )
                 ),
                 finished=jax.lax.dynamic_update_slice(
-                    st.finished,
+                    co.finished,
                     jnp.zeros((1, K), bool),
                     (slot, jnp.int32(0)),
                 ),
                 tokens=jax.lax.dynamic_update_slice(
-                    st.tokens,
+                    co.tokens,
                     jnp.full((K,), BOS_ID, jnp.int32),
                     (row0,),
                 ),
                 step=jax.lax.dynamic_update_slice(
-                    st.step, jnp.zeros((1,), jnp.int32), (slot,)
+                    co.step, jnp.zeros((1,), jnp.int32), (slot,)
                 ),
             )
+            return SlotState(core=core, cache=cache)
 
         @jax.jit
         def tick(params, st: SlotState, slots, rows: DecodeCache):
@@ -371,8 +340,10 @@ class SlotDecoder:
                     )
             for _ in range(block):
                 st = step_once(params, st)
-            done = jnp.all(st.finished, axis=-1) | (st.step >= L)
-            return st, done, st.seqs, st.scores
+            done = jnp.all(st.core.finished, axis=-1) | (
+                st.core.step >= L
+            )
+            return st, done, st.core.seqs, st.core.scores
 
         self._tick_fns[A] = tick
         return tick
@@ -493,7 +464,11 @@ class SlotDecoder:
                 )
         if self._np_seq != handle.seq:
             self._seqs_np = np.asarray(jax.device_get(handle.seqs))
-            self._scores_np = np.asarray(jax.device_get(handle.scores))
+            # Greedy slots carry no beam scores (CoreState.scores=None).
+            self._scores_np = (
+                None if handle.scores is None
+                else np.asarray(jax.device_get(handle.scores))
+            )
             self._np_seq = handle.seq
         seqs = self._seqs_np[list(slots)]                 # (n, K, L)
         if self.greedy:
@@ -565,3 +540,100 @@ class SlotDecoder:
             "mode": "greedy" if self.greedy else "beam",
             "admit_cap": self.admit_cap,
         }
+
+
+# ------------------------------------------------ parity-harness backends
+
+class _ParityEngine:
+    """The minimal engine surface a :class:`SlotDecoder` needs, built
+    straight from a :class:`~cst_captioning_tpu.decoding.core.ParityCtx`
+    — so the shared parity harness (tests/test_decode_core.py) can
+    drive the slot loop without the HTTP/batcher/cache stack.
+    "Prepared requests" are plain video indices into the ctx batch."""
+
+    def __init__(self, ctx, *, mode: str, num_slots: int, block: int):
+        from types import SimpleNamespace
+
+        self.model = ctx.make_model()
+        self.params = ctx.params
+        self.decode_mode = mode
+        self.max_batch = num_slots
+        self.device = None
+        self._feats, self._masks, self._cat = (
+            ctx.feats, ctx.masks, ctx.category,
+        )
+        d0 = next(iter(ctx.feats.values()))
+        self.cfg = SimpleNamespace(
+            serving=SimpleNamespace(
+                num_slots=num_slots, slot_block_steps=block
+            ),
+            eval=SimpleNamespace(
+                beam_size=ctx.beam_size, max_decode_len=ctx.max_len,
+                length_normalize=True,
+            ),
+            data=SimpleNamespace(
+                max_frames=d0.shape[1],
+                feature_modalities=list(ctx.feats),
+                feature_dims={
+                    m: a.shape[-1] for m, a in ctx.feats.items()
+                },
+            ),
+        )
+
+    def encode_prepared_rows(self, reqs):
+        ids = jnp.asarray(np.asarray(reqs, np.int32))
+        feats = {m: a[ids] for m, a in self._feats.items()}
+        masks = {m: a[ids] for m, a in self._masks.items()}
+        cat = self._cat[ids] if self._cat is not None else None
+        _, cache = self.model.apply(
+            self.params, feats, masks, cat, method="init_decode"
+        )
+        return cache
+
+    def template_prepared(self):
+        return 0
+
+
+def _slot_runner(ctx, mode: str):
+    """Decode every ctx row through a small slot matrix with staggered
+    admissions (slots hold rows at different decode depths), then map
+    harvests back to row order."""
+    B = next(iter(ctx.feats.values())).shape[0]
+    eng = _ParityEngine(
+        ctx, mode=mode, num_slots=max(2, B // 2), block=1
+    )
+    dec = SlotDecoder(eng)
+    got_tok: Dict[int, np.ndarray] = {}
+    got_score: Dict[int, float] = {}
+    pending = list(range(B))
+    stagger = 0
+    while pending or dec.occupied:
+        n = min(1 + stagger % 2, len(pending), len(dec.free),
+                dec.admit_cap)
+        adm = [pending.pop(0) for _ in range(n)]
+        stagger += 1
+        done = dec.tick(adm, adm)
+        for i, tokens, score, steps in dec.harvest_many(done):
+            got_tok[i], got_score[i] = tokens, score
+            assert 0 < steps <= dec.L
+    return {
+        "tokens": np.stack([got_tok[i] for i in range(B)]),
+        "scores": (
+            np.asarray([got_score[i] for i in range(B)], np.float32)
+            if mode == "beam" else None
+        ),
+    }
+
+
+register_backend(
+    "slot_decoder_beam",
+    lambda ctx: _slot_runner(ctx, "beam"),
+    kind="beam",
+    ref="scan_beam",
+)
+register_backend(
+    "slot_decoder_greedy",
+    lambda ctx: _slot_runner(ctx, "greedy"),
+    kind="greedy",
+    ref="scan_greedy",
+)

@@ -46,7 +46,7 @@ from __future__ import annotations
 import functools
 import logging
 import time
-from typing import Callable
+from typing import Any, Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -55,7 +55,17 @@ import optax
 from jax.experimental import io_callback
 
 from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
-from cst_captioning_tpu.models.captioner import CaptionModel
+from cst_captioning_tpu.decoding.core import (
+    CoreState,
+    DecodeState,
+    decode_step,
+    register_backend,
+    row_sample_fn,
+)
+from cst_captioning_tpu.models.captioner import (
+    CaptionModel,
+    _repeat_cache,
+)
 from cst_captioning_tpu.ops.losses import reward_criterion
 from cst_captioning_tpu.training.rewards import (
     CiderDRewarder,
@@ -209,6 +219,9 @@ def make_cst_train_step(
     layout = getattr(cfg.train, "cst_split_layout", "auto")
     if layout not in ("auto", "pipeline", "chunked"):
         raise ValueError(f"unknown cst_split_layout {layout!r}")
+    rollout_layout = getattr(cfg.train, "cst_rollout", "scan")
+    if rollout_layout not in ("scan", "padded", "slot"):
+        raise ValueError(f"unknown cst_rollout {rollout_layout!r}")
     rewarder = CiderDRewarder(
         train_ds,
         df_mode=cfg.data.idf_file or "corpus",
@@ -227,6 +240,15 @@ def make_cst_train_step(
             "CST reward scoring: multiprocess pool with %d workers",
             scorer.num_workers,
         )
+    if rollout_layout != "scan":
+        # Slot-based (or its padded bit-twin) rollout: rows exit on EOS
+        # and stream straight to the scorer — a host-driven loop on
+        # every backend (the one-graph io_callback step keeps the
+        # fused "scan" rollout; this path trades one graph for ~E[len]/L
+        # of its decode steps, docs/PERF.md r10).
+        log.info("CST rollout layout: %s (slot decode runtime)",
+                 rollout_layout)
+        return _make_slot_step(model, cfg, scorer, rollout_layout)
     if io_callback_supported():
         if layout != "auto":
             # The split layouts only exist for backends WITHOUT host
@@ -859,3 +881,420 @@ def _make_split_step(model, cfg, scorer) -> Callable:
     train_step.layout = "split"
     train_step.scorer = scorer
     return train_step
+
+
+# ---------------------------------------------------- slot rollout variant
+
+class SlotRolloutState(NamedTuple):
+    """Device-resident state of the CST rollout slot matrix: the unified
+    decode carry plus per-slot occupancy metadata.  ``row_id`` is the
+    occupant's GLOBAL row index in the step's rollout matrix (sampled
+    rows first, then greedy-baseline rows; -1 = empty) — the identity
+    the row-keyed PRNG derives from, so slot position and admission
+    order cannot change any sampled token."""
+
+    core: CoreState
+    cache: Any                # DecodeCache rows, leaves lead with (S,)
+    row_id: jax.Array         # (S,) int32
+    is_sample: jax.Array      # (S,) bool — multinomial vs greedy row
+
+
+class SlotRollout:
+    """Slot-based CST rollout decode: sampled-rollout and greedy-
+    baseline rows occupy persistent device slots, exit on EOS, and are
+    harvested at step boundaries — the serving slot machinery
+    (PR 3) reused in training, via the same unified decode core.
+
+    ``layout="slot"``: ``n_slots`` slots (< total rows) with admission
+    as slots free — total decode cost ~ sum(row lengths) instead of
+    rows x L.  ``layout="padded"``: every row resident from tick 0 and
+    exactly ceil(L/block) ticks — today's padded cost, same row-keyed
+    math, used as the bit-identical baseline of the paired bench rows.
+
+    Sampling is row-keyed (``decoding/core.py::row_sample_fn``): row
+    ``r`` at decode position ``t`` draws from
+    ``fold_in(fold_in(rng, r), t)`` — never from slot position or
+    admission tick — so both layouts produce bit-identical tokens per
+    row, and therefore bit-identical rewards, losses and params
+    (docs/PARITY.md "slot rollout invariance"; pinned by
+    tests/test_cst.py and the shared parity harness).
+    """
+
+    def __init__(self, model, *, max_len: int, temperature: float,
+                 n_slots: int = 0, block: int = 1, padded: bool = False):
+        self.model = model
+        self.L = int(max_len)
+        self.T = float(temperature)
+        self.n_slots_cfg = int(n_slots)
+        self.block = max(1, int(block))
+        self.padded = bool(padded)
+        self._tick_fns: dict = {}
+        self._sst_cache: dict = {}
+
+        def prepare(params, feats, masks, category, repeat, need_greedy):
+            _, cache = model.apply(
+                params, feats, masks, category, method="init_decode"
+            )
+            rcache = _repeat_cache(cache, repeat)
+            if need_greedy:
+                return jax.tree.map(
+                    lambda a, b: jnp.concatenate([a, b], axis=0),
+                    rcache, cache,
+                )
+            return rcache
+
+        self._prepare = jax.jit(prepare, static_argnums=(4, 5))
+
+    # ------------------------------------------------------------- device
+    def _tick_fn(self, A: int):
+        """One compiled rollout iteration: scatter A admissions (gather
+        their pre-encoded cache rows by row id), then run the step
+        block.  Mirrors ``serving/slots.py`` exactly — constant
+        dispatches per iteration regardless of churn."""
+        if A in self._tick_fns:
+            return self._tick_fns[A]
+        model, L, T, block = self.model, self.L, self.T, self.block
+
+        @jax.jit
+        def tick(params, sst: SlotRolloutState, cache_all, admit_ids,
+                 admit_slots, rng, n_sample_rows):
+            if A:
+                rows = jax.tree.map(lambda x: x[admit_ids], cache_all)
+                # Padding repeats the LAST (id, slot) pair: duplicate
+                # scatter indices write identical values — idempotent.
+                cache = jax.tree.map(
+                    lambda leaf, r: leaf.at[admit_slots].set(
+                        r.astype(leaf.dtype)
+                    ),
+                    sst.cache, rows,
+                )
+                co = sst.core
+                core = co._replace(
+                    state=DecodeState(
+                        h=co.state.h.at[:, admit_slots].set(0.0),
+                        c=co.state.c.at[:, admit_slots].set(0.0),
+                    ),
+                    seqs=co.seqs.at[admit_slots].set(PAD_ID),
+                    finished=co.finished.at[admit_slots].set(False),
+                    tokens=co.tokens.at[admit_slots].set(BOS_ID),
+                    step=co.step.at[admit_slots].set(0),
+                )
+                sst = SlotRolloutState(
+                    core=core,
+                    cache=cache,
+                    row_id=sst.row_id.at[admit_slots].set(admit_ids),
+                    is_sample=sst.is_sample.at[admit_slots].set(
+                        admit_ids < n_sample_rows
+                    ),
+                )
+
+            def step_logits(state, tokens):
+                return model.apply(
+                    params, state, sst.cache, tokens,
+                    method="decode_logits",
+                )
+
+            sample_fn = row_sample_fn(rng, sst.row_id, sst.is_sample)
+            core = sst.core
+            for _ in range(block):
+                core = decode_step(
+                    step_logits, core, mode="sample", temperature=T,
+                    sample_fn=sample_fn,
+                )
+            sst = sst._replace(core=core)
+            done = jnp.all(core.finished, axis=-1) | (core.step >= L)
+            return sst, done, core.seqs
+
+        self._tick_fns[A] = tick
+        return tick
+
+    def _init_state(self, S: int, cache_all) -> SlotRolloutState:
+        model, L = self.model, self.L
+        cdt = jnp.dtype(model.compute_dtype)
+        core = CoreState(
+            state=DecodeState(
+                h=jnp.zeros((model.num_layers, S, model.rnn_size), cdt),
+                c=jnp.zeros(
+                    (model.num_layers, S, model.rnn_size), jnp.float32
+                ),
+            ),
+            seqs=jnp.full((S, 1, L), PAD_ID, jnp.int32),
+            scores=None,
+            lps=None,
+            # Empty slots ride as finished/step=L: done, frozen.
+            finished=jnp.ones((S, 1), bool),
+            tokens=jnp.full((S,), BOS_ID, jnp.int32),
+            step=jnp.full((S,), L, jnp.int32),
+            rng=None,
+        )
+        cache = jax.tree.map(
+            lambda x: jnp.zeros((S,) + x.shape[1:], x.dtype), cache_all
+        )
+        return SlotRolloutState(
+            core=core,
+            cache=cache,
+            row_id=jnp.full((S,), -1, jnp.int32),
+            is_sample=jnp.zeros((S,), bool),
+        )
+
+    # --------------------------------------------------------------- host
+    def resolve_slots(self, n_rows: int) -> int:
+        if self.padded:
+            return n_rows
+        if self.n_slots_cfg > 0:
+            return min(self.n_slots_cfg, n_rows)
+        # Default: quarter of the rows (>= 8) — enough churn headroom
+        # that freed slots refill while stragglers run (docs/PERF.md r10).
+        return max(1, min(n_rows, max(8, -(-n_rows // 4))))
+
+    def run(self, params, feats, feat_masks, category, rng, *,
+            repeat: int, need_greedy: bool, on_harvest=None):
+        """Decode ``B*repeat`` sampled rows (+ B greedy rows) through
+        the slot matrix.  ``on_harvest(row_ids, tokens)`` fires at every
+        harvest boundary with freshly-exited rows — the CST step streams
+        them straight into ``RewardPool.submit`` so scoring overlaps the
+        remaining decode.  Returns ``(tokens (N, L) int32, stats)``;
+        rows ``[0, B*repeat)`` are the rollout, the tail the greedy
+        baseline."""
+        B = next(iter(feats.values())).shape[0]
+        n_sample = B * repeat
+        N = n_sample + (B if need_greedy else 0)
+        L, block = self.L, self.block
+        S = self.resolve_slots(N)
+        cache_all = self._prepare(
+            params, feats, feat_masks, category, repeat, need_greedy
+        )
+        # Reuse the previous step's final slot state for this geometry:
+        # leftover rows ride FROZEN (finished, step=L, never harvested)
+        # and every op is row-independent, so stale co-residents cannot
+        # change an admitted row's numbers — the same argument that
+        # makes admission order irrelevant (docs/PARITY.md).
+        sst = self._sst_cache.get(S)
+        if sst is None:
+            sst = self._init_state(S, cache_all)
+        n_sample_arr = jnp.int32(n_sample)
+        pending = list(range(N))
+        free = list(range(S))
+        occupied: dict = {}
+        admit_tick: dict = {}
+        out = np.full((N, L), PAD_ID, np.int32)
+        ticks = 0
+        row_steps = 0
+        min_ticks = -(-L // block)  # padded layout: today's full-L cost
+        while pending or occupied:
+            n = min(len(free), len(pending))
+            ids = [pending.pop(0) for _ in range(n)]
+            if n:
+                # ONE admission bucket (A = S, padded by repeating the
+                # last (id, slot) pair — duplicate scatters of identical
+                # values are idempotent): exactly two compiled tick
+                # variants per geometry (admit / pure-step), where a
+                # per-count bucket ladder would re-trace mid-epoch on
+                # every new harvest pattern.
+                A = S
+                slots = [free.pop() for _ in range(n)]
+                ids_arr = jnp.asarray(
+                    np.asarray(ids + [ids[-1]] * (A - n), np.int32)
+                )
+                slot_arr = jnp.asarray(
+                    np.asarray(slots + [slots[-1]] * (A - n), np.int32)
+                )
+                for s, r in zip(slots, ids):
+                    occupied[s] = r
+                    admit_tick[s] = ticks
+            else:
+                A = 0
+                ids_arr = slot_arr = None
+            sst, done, seqs = self._tick_fn(A)(
+                params, sst, cache_all, ids_arr, slot_arr, rng,
+                n_sample_arr,
+            )
+            ticks += 1
+            if self.padded and ticks < min_ticks:
+                continue  # padded twin: every row pays the full L steps
+            done_np = np.asarray(done)
+            done_slots = [s for s in occupied if done_np[s]]
+            if not done_slots:
+                continue
+            seqs_np = np.asarray(seqs)
+            h_ids, h_toks = [], []
+            for s in done_slots:
+                r = occupied.pop(s)
+                free.append(s)
+                out[r] = seqs_np[s, 0]
+                row_steps += min((ticks - admit_tick.pop(s)) * block, L)
+                h_ids.append(r)
+                h_toks.append(out[r])
+            if on_harvest is not None:
+                on_harvest(h_ids, np.stack(h_toks))
+        self._sst_cache[S] = sst
+        lengths = (out != PAD_ID).sum(axis=1)
+        stats = {
+            "rollout_ticks": ticks,
+            "rollout_decode_steps": ticks * block,
+            "rollout_steps_per_row": round(row_steps / max(1, N), 3),
+            "rollout_mean_len": round(float(lengths.mean()), 3),
+            "rollout_slots": S,
+            "rollout_rows": N,
+        }
+        return out, stats
+
+
+def _make_slot_step(model, cfg, scorer, layout: str) -> Callable:
+    """CST step whose rollout runs through :class:`SlotRollout`
+    (``layout`` = "slot" or its bit-twin "padded").  Phase structure
+    mirrors ``_make_split_step``: decode (slot loop, harvested rows
+    streamed to the scorer as they exit), one blocking reward wait,
+    one jitted PG update.  Rewards are paired back to rows BY ROW ID,
+    not harvest order — harvest order carries no information
+    (docs/PARITY.md)."""
+    S, baseline_kind = _validate(cfg)
+    temperature = cfg.train.sample_temperature
+    max_len = cfg.data.max_seq_len
+    need_greedy = baseline_kind == "greedy"
+    gt_base_np = (
+        scorer.gt_consensus() if baseline_kind == "gt_consensus" else None
+    )
+    rollout = SlotRollout(
+        model,
+        max_len=max_len,
+        temperature=temperature,
+        n_slots=max(0, getattr(cfg.train, "cst_slot_count", 0)),
+        block=max(1, getattr(cfg.train, "cst_slot_block_steps", 1)),
+        padded=layout == "padded",
+    )
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def update_fn(state, feats, feat_masks, category, tokens, advantage):
+        mask = (tokens != PAD_ID).astype(jnp.float32)
+        return _pg_update(
+            state, feats, feat_masks, category, S, tokens, mask,
+            advantage, temperature,
+            suppress_unk=model.decode_suppress_unk,
+        )
+
+    def _trim_len(tokens_np) -> int:
+        """Time-axis bucket for the PG update: the rollout's rows exit
+        on EOS, so every column past the longest harvested row is PAD
+        with mask 0 — zero loss, zero gradient.  Trimming them cuts the
+        update's T-step scan to ~max(len)/L of its cost.  Power-of-two
+        buckets bound the jit cache; BOTH layouts trim from the SAME
+        (bit-identical) token matrix, so the padded-vs-slot parity
+        contract is untouched (docs/PARITY.md r10)."""
+        longest = int((tokens_np != PAD_ID).sum(axis=1).max())
+        t = 8
+        while t < longest + 1:
+            t *= 2
+        return min(t, max_len)
+
+    clock = PhaseClock()
+    phase_ms: dict = {}
+    last_stats: dict = {}
+
+    def train_step(state, feats, feat_masks, captions, weights, category,
+                   video_idx, rng, ss_prob):
+        clock.start()
+        vid = np.asarray(video_idx)
+        B = vid.shape[0]
+        n_sample = B * S
+        pending: list = []
+
+        def on_harvest(row_ids, tokens):
+            # Stream freshly-exited rows to the scorer: a pooled scorer
+            # works them in its processes while the slot loop keeps
+            # decoding.  Rewards scatter back by row id at the wait.
+            samp = [(r, i) for i, r in enumerate(row_ids) if r < n_sample]
+            gred = [(r, i) for i, r in enumerate(row_ids) if r >= n_sample]
+            if samp:
+                rows = np.asarray([r for r, _ in samp])
+                pending.append((
+                    rows,
+                    scorer.submit(vid[rows // S],
+                                  tokens[[i for _, i in samp]]),
+                ))
+            if gred:
+                rows = np.asarray([r for r, _ in gred])
+                pending.append((
+                    rows,
+                    scorer.submit(vid[rows - n_sample],
+                                  tokens[[i for _, i in gred]]),
+                ))
+
+        tokens_all, stats = rollout.run(
+            state.params, feats, feat_masks, category, rng,
+            repeat=S, need_greedy=need_greedy, on_harvest=on_harvest,
+        )
+        last_stats.clear()
+        last_stats.update(stats)
+        clock.lap("dispatch_ms")
+
+        scores_all = np.zeros((tokens_all.shape[0],), np.float32)
+        for rows, p in pending:
+            scores_all[rows] = p.wait()
+        rewards = scores_all[:n_sample]
+        greedy_scores = scores_all[n_sample:] if need_greedy else None
+        clock.lap("score_wait_ms")
+
+        baseline = _baseline_from(
+            rewards, greedy_scores, S, baseline_kind,
+            gt_rows=None if gt_base_np is None else gt_base_np[vid],
+        )
+        advantage = rewards - baseline
+        Lt = _trim_len(tokens_all[:n_sample])
+        last_stats["update_trim_len"] = Lt
+        state, loss, gnorm = update_fn(
+            state, feats, feat_masks, category,
+            jnp.asarray(tokens_all[:n_sample, :Lt]),
+            jnp.asarray(advantage),
+        )
+        clock.lap("update_ms")
+        clock.commit(phase_ms)
+        # Host floats for the host-computed stats (the pipelined-step
+        # convention): re-uploading them would cost device transfers
+        # every step for values every consumer wants on the host.
+        return state, {
+            "loss": loss,
+            "grad_norm": gnorm,
+            "reward": float(rewards.mean()),
+            "baseline": float(baseline.mean()),
+            "advantage": float(advantage.mean()),
+            "rollout_steps_per_row": float(
+                stats["rollout_steps_per_row"]
+            ),
+        }
+
+    train_step.phase_ms = phase_ms
+    train_step.layout = f"slot:{layout}"
+    train_step.scorer = scorer
+    train_step.rollout_stats = last_stats
+    return train_step
+
+
+# ------------------------------------------------ parity-harness backends
+
+def _rollout_runner(ctx, layout: str):
+    """Registry runner: the full CST rollout token matrix (sampled +
+    greedy-baseline rows) through the requested layout."""
+    model = ctx.make_model()
+    ro = SlotRollout(
+        model, max_len=ctx.max_len, temperature=ctx.temperature,
+        padded=layout == "padded",
+    )
+    tokens, stats = ro.run(
+        ctx.params, ctx.feats, ctx.masks, ctx.category, ctx.rng,
+        repeat=ctx.repeat, need_greedy=True,
+    )
+    return {"tokens": tokens, "stats": stats}
+
+
+register_backend(
+    "padded_rollout",
+    lambda ctx: _rollout_runner(ctx, "padded"),
+    kind="rollout",
+)
+register_backend(
+    "slot_rollout",
+    lambda ctx: _rollout_runner(ctx, "slot"),
+    kind="rollout",
+    ref="padded_rollout",
+)

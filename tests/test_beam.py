@@ -98,14 +98,8 @@ class TestBeamSearch:
             np.asarray(r.tokens), np.asarray(r.all_tokens[:, 0])
         )
 
-    def test_beam1_equals_greedy(self, np_rng):
-        model, params, feats, masks = tiny_model(np_rng)
-        r = beam_search(
-            model, params, feats, masks, beam_size=1, max_len=6,
-            length_normalize=False,
-        )
-        g = model.apply(params, feats, masks, max_len=6, method="sample")
-        np.testing.assert_array_equal(np.asarray(r.tokens), np.asarray(g.tokens))
+    # beam1 == greedy moved to the shared parity harness
+    # (tests/test_decode_core.py::TestSharedParity::test_beam1_equals_greedy).
 
     @pytest.mark.parametrize("length_normalize", [False, True])
     def test_wide_beam_finds_exhaustive_optimum(self, np_rng, length_normalize):

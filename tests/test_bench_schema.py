@@ -69,6 +69,49 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="bool-typed"):
             validate_record(rec)
 
+    def test_slot_rollout_step_accounting_fields_pass(self):
+        """The paired padded-vs-slot CST rows carry decode-step and
+        harvest-tick accounting (ISSUE 6): numeric values validate."""
+        rec = good_bench()
+        rec["extra"].update(
+            cst_rollout_steps_per_row=3.3,
+            cst_slot_harvest_ticks=6,
+            cst_slot_decode_steps=12,
+            cst_slot_host_cores=1,
+        )
+        validate_record(rec)
+
+    def test_bool_steps_per_row_fails(self):
+        rec = good_bench()
+        rec["extra"]["cst_rollout_steps_per_row"] = True
+        with pytest.raises(ValueError, match="bool-typed"):
+            validate_record(rec)
+
+    def test_bool_harvest_ticks_fails(self):
+        rec = good_bench()
+        rec["extra"]["cst_slot_harvest_ticks"] = False
+        with pytest.raises(ValueError, match="bool-typed"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize(
+        "key", ["cst_slot_host_cores", "cst_pipe_host_cores",
+                "serving_replicas_host_cores"]
+    )
+    @pytest.mark.parametrize("bad", [True, None, "1", 0, -2])
+    def test_host_cores_must_be_positive_count(self, key, bad):
+        """CPU-host caveats are machine-readable (ISSUE 6 satellite):
+        any *_host_cores field must be a real positive core count, the
+        way PR 5 pinned cst_pipe_host_cores in prose."""
+        rec = good_bench()
+        rec["extra"][key] = bad
+        with pytest.raises(ValueError, match="core count"):
+            validate_record(rec)
+
+    def test_host_cores_numeric_passes(self):
+        rec = good_bench()
+        rec["extra"]["cst_slot_host_cores"] = 8
+        validate_record(rec)
+
     def test_non_dict_extra_fails(self):
         rec = good_bench()
         rec["extra"] = [1, 2]

@@ -496,6 +496,69 @@ class TestSplitStep:
         assert io_callback_supported() is True  # CPU supports it
 
 
+class TestSlotRolloutStep:
+    """The slot-based CST rollout (training/cst.py::SlotRollout via the
+    unified decode core): fixed-seed padded-vs-slot runs must be
+    BIT-identical — row-keyed PRNG means slot geometry and admission
+    order carry no information (docs/PARITY.md slot-rollout contract)."""
+
+    @pytest.mark.parametrize("baseline", ["greedy", "scb"])
+    def test_padded_vs_slot_bit_identical(self, corpus, tmp_path,
+                                          baseline):
+        from cst_captioning_tpu.training.cst import _make_slot_step
+
+        cfg_p, model_p, rewarder_p, run_p = split_setup(
+            corpus, tmp_path, baseline, cst_rollout="padded"
+        )
+        s_pad, m_pad = run_p.steps(
+            _make_slot_step(model_p, cfg_p, rewarder_p, "padded"), 2
+        )
+        cfg_s, model_s, rewarder_s, run_s = split_setup(
+            corpus, tmp_path, baseline, cst_rollout="slot",
+            cst_slot_count=5, cst_slot_block_steps=2,
+        )
+        s_slot, m_slot = run_s.steps(
+            _make_slot_step(model_s, cfg_s, rewarder_s, "slot"), 2
+        )
+        for a, b in zip(m_pad, m_slot):
+            for k in ("loss", "reward", "baseline", "advantage"):
+                assert float(a[k]) == float(b[k]), k
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            s_pad.params,
+            s_slot.params,
+        )
+        # The slot layout really paid fewer decode steps per row.
+        assert float(m_slot[-1]["rollout_steps_per_row"]) <= float(
+            m_pad[-1]["rollout_steps_per_row"]
+        )
+
+    def test_make_cst_train_step_dispatches_slot(self, corpus, tmp_path):
+        from cst_captioning_tpu.training import cst as cst_mod
+
+        cfg, model, rewarder, run = split_setup(
+            corpus, tmp_path, "greedy", cst_rollout="slot"
+        )
+        ds, _ = corpus
+        step = cst_mod.make_cst_train_step(model, cfg, ds)
+        assert step.layout == "slot:slot"
+        _, m = run(step)
+        assert "rollout_steps_per_row" in m
+        assert step.rollout_stats["rollout_rows"] == 8 * 3 + 8
+
+    def test_unknown_rollout_layout_fails(self, corpus, tmp_path):
+        from cst_captioning_tpu.training import cst as cst_mod
+
+        cfg, model, _, _ = split_setup(
+            corpus, tmp_path, "greedy", cst_rollout="banana"
+        )
+        ds, _ = corpus
+        with pytest.raises(ValueError, match="cst_rollout"):
+            cst_mod.make_cst_train_step(model, cfg, ds)
+
+
 class TestShardedRewardCallback:
     """One-graph step with a data-sharded reward io_callback (the
     anti-involuntary-remat construction) must match the unannotated

@@ -1,0 +1,260 @@
+"""The unified decode runtime (decoding/core.py).
+
+ONE parity harness for EVERY registered decode backend: the scan beam,
+the fused Pallas beam, the fused Pallas sampler, the serving slot
+decoder (beam + greedy) and the CST slot rollout all decode the SAME
+fixed inputs and are pinned token-exact against their declared
+reference — replacing the per-backend parity copies that used to live
+in test_beam.py / test_pallas_beam.py / test_pallas_sampler.py /
+test_serving.py.
+
+Plus the single-definition-site guard: the per-step decode recurrence
+exists exactly once (``decoding/core.py::decode_step``); every XLA
+consumer must import it, and a tokenizer-stripped grep fails the build
+if a new module re-implements the step math (the fused kernel bodies
+are the explicit allowlist — a Pallas kernel cannot call back into
+XLA ops).
+"""
+
+import io
+import re
+import tokenize
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import cst_captioning_tpu
+from cst_captioning_tpu.constants import EOS_ID, PAD_ID
+from cst_captioning_tpu.decoding import core
+from cst_captioning_tpu.models import CaptionModel
+
+ALL_BACKENDS = core.load_backends()
+
+# Shapes chosen so the fused kernels ENGAGE (B % 8 == 0 for the sampler
+# gate; V large enough for the beam kernel's vocab floor) — a gated-off
+# kernel would "pass" parity by silently running the scan path.
+V, B, F, D, H = 40, 8, 3, 12, 16
+K, L = 4, 8
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    rng = np.random.RandomState(2)
+    base = dict(
+        vocab_size=V, rnn_size=H, num_layers=1, embed_size=H,
+        att_hidden_size=H, fusion="attention", modalities=("resnet",),
+        feature_dims=(D,), compute_dtype="float32", drop_prob=0.0,
+    )
+
+    def make_model(**overrides):
+        kw = dict(base)
+        kw.update(overrides)
+        return CaptionModel(**kw)
+
+    feats = {"resnet": jnp.asarray(rng.randn(B, F, D), jnp.float32)}
+    masks = {"resnet": jnp.ones((B, F), jnp.float32)}
+    ids = jnp.asarray(rng.randint(4, V, (B, 6)), jnp.int32)
+    params = make_model().init(jax.random.PRNGKey(0), feats, masks, ids)
+    return core.ParityCtx(
+        make_model=make_model, params=params, feats=feats, masks=masks,
+        category=None, beam_size=K, max_len=L, temperature=0.9,
+        rng=jax.random.PRNGKey(11),
+        video_idx=jnp.arange(B, dtype=jnp.int32), repeat=2,
+    )
+
+
+class TestSharedParity:
+    """Every backend with a declared reference, through identical
+    inputs: tokens EXACT, scores/log-probs allclose."""
+
+    @pytest.mark.parametrize(
+        "name", [n for n in ALL_BACKENDS if core.get_backend(n).ref]
+    )
+    def test_backend_matches_reference(self, ctx, name):
+        backend = core.get_backend(name)
+        got = backend.run(ctx)
+        ref = core.get_backend(backend.ref).run(ctx)
+        np.testing.assert_array_equal(
+            got["tokens"], ref["tokens"],
+            err_msg=f"{name} tokens diverged from {backend.ref}",
+        )
+        if got.get("scores") is not None and ref.get("scores") is not None:
+            np.testing.assert_allclose(
+                got["scores"], ref["scores"], rtol=1e-4, atol=1e-5,
+            )
+        if got.get("lps") is not None and ref.get("lps") is not None:
+            np.testing.assert_allclose(
+                got["lps"], ref["lps"], rtol=1e-4, atol=1e-5,
+            )
+        if got.get("mask") is not None and ref.get("mask") is not None:
+            np.testing.assert_array_equal(got["mask"], ref["mask"])
+
+    def test_all_five_consumers_registered(self):
+        """The acceptance bar names five decode consumers; all must sit
+        behind the one registry."""
+        assert {
+            "scan_beam", "fused_beam", "fused_sampler",
+            "slot_decoder_beam", "slot_decoder_greedy",
+            "padded_rollout", "slot_rollout",
+        } <= set(ALL_BACKENDS)
+
+    def test_beam1_equals_greedy(self, ctx):
+        """Cross-mode coherence: a width-1 beam IS the greedy decode
+        (formerly pinned per-backend in test_beam / test_pallas_beam)."""
+        from cst_captioning_tpu.decoding import beam_search
+
+        r = beam_search(
+            ctx.make_model(), ctx.params, ctx.feats, ctx.masks,
+            beam_size=1, max_len=L, length_normalize=False,
+        )
+        g = core.get_backend("scan_greedy").run(ctx)
+        np.testing.assert_array_equal(np.asarray(r.tokens), g["tokens"])
+
+
+class TestSampleEarlyExit:
+    """The offline greedy/multinomial scan paths' all-rows-finished
+    ``lax.while_loop`` early exit (the PR-3 beam treatment) is
+    output-identical to the fixed-length scan — including when every
+    row EOSes immediately, the case the exit actually fires on."""
+
+    def _compare(self, ctx, params, greedy):
+        m = ctx.make_model()
+        kw = dict(max_len=L, greedy=greedy, method="sample")
+        if not greedy:
+            kw.update(rng=jax.random.PRNGKey(5), temperature=0.8)
+        fast = m.apply(ctx.params if params is None else params,
+                       ctx.feats, ctx.masks, early_exit=True, **kw)
+        full = m.apply(ctx.params if params is None else params,
+                       ctx.feats, ctx.masks, early_exit=False, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(fast.tokens), np.asarray(full.tokens)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fast.logprobs), np.asarray(full.logprobs)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(fast.mask), np.asarray(full.mask)
+        )
+        return fast
+
+    @pytest.mark.parametrize("greedy", [True, False])
+    def test_natural_lengths(self, ctx, greedy):
+        self._compare(ctx, None, greedy)
+
+    @pytest.mark.parametrize("greedy", [True, False])
+    def test_all_eos_immediately(self, ctx, greedy):
+        p = dict(ctx.params)
+        pp = dict(p["params"])
+        b = np.asarray(pp["logit_b"]).copy()
+        b[EOS_ID] += 50.0
+        pp["logit_b"] = jnp.asarray(b)
+        p["params"] = pp
+        out = self._compare(ctx, p, greedy)
+        toks = np.asarray(out.tokens)
+        assert (toks[:, 0] == EOS_ID).all()
+        assert (toks[:, 1:] == PAD_ID).all()
+        assert np.asarray(out.mask)[:, 1:].sum() == 0
+
+
+class TestSlotRolloutInvariance:
+    """Row-keyed PRNG: the sampled rollout tokens depend on (rng,
+    row_id, step) only — slot count, block size, and admission order
+    cannot change any token (docs/PARITY.md slot-rollout contract)."""
+
+    @pytest.mark.parametrize("n_slots,block", [(3, 1), (5, 2)])
+    def test_tokens_invariant_to_slot_geometry(self, ctx, n_slots, block):
+        from cst_captioning_tpu.training.cst import SlotRollout
+
+        ref = core.get_backend("padded_rollout").run(ctx)
+        ro = SlotRollout(
+            ctx.make_model(), max_len=ctx.max_len,
+            temperature=ctx.temperature, n_slots=n_slots, block=block,
+        )
+        tokens, stats = ro.run(
+            ctx.params, ctx.feats, ctx.masks, ctx.category, ctx.rng,
+            repeat=ctx.repeat, need_greedy=True,
+        )
+        np.testing.assert_array_equal(tokens, ref["tokens"])
+        assert stats["rollout_slots"] == n_slots
+
+    def test_harvest_stream_covers_all_rows_once(self, ctx):
+        from cst_captioning_tpu.training.cst import SlotRollout
+
+        seen = []
+        ro = SlotRollout(
+            ctx.make_model(), max_len=ctx.max_len,
+            temperature=ctx.temperature, n_slots=4,
+        )
+        tokens, stats = ro.run(
+            ctx.params, ctx.feats, ctx.masks, ctx.category, ctx.rng,
+            repeat=ctx.repeat, need_greedy=True,
+            on_harvest=lambda ids, toks: seen.extend(ids),
+        )
+        n = B * ctx.repeat + B
+        assert sorted(seen) == list(range(n))
+        assert stats["rollout_rows"] == n
+        assert 0 < stats["rollout_steps_per_row"] <= ctx.max_len
+
+
+# ---------------------------------------------- single-definition guard
+
+def _code_only(path: Path) -> str:
+    """Source with comments and string literals stripped — docstring
+    mentions of the recurrence must not trip the guard."""
+    out = []
+    toks = tokenize.generate_tokens(
+        io.StringIO(path.read_text()).readline
+    )
+    for tok in toks:
+        if tok.type in (tokenize.COMMENT, tokenize.STRING):
+            continue
+        out.append(tok.string)
+    return " ".join(out)
+
+
+# (pattern, files allowed to contain it).  The Pallas kernel bodies and
+# their bit-exact XLA twins keep in-kernel recurrences by necessity —
+# they are the explicit allowlist, everything else must import
+# decoding/core.py.
+_FINGERPRINTS = [
+    # beam selection: top-K over score+logp totals
+    (re.compile(r"\btop_k\s*\("),
+     {"decoding/core.py", "ops/pallas_beam.py"}),
+    # finish update: tok == EOS | tok == PAD
+    (re.compile(r"==\s*EOS_ID\s*\)\s*\|\s*\(\s*\w+\s*==\s*PAD_ID"),
+     {"decoding/core.py", "ops/pallas_beam.py", "ops/pallas_sampler.py"}),
+    # PAD -> EOS feed of finished rows
+    (re.compile(r"==\s*PAD_ID\s*,\s*EOS_ID"),
+     {"decoding/core.py", "ops/pallas_beam.py", "ops/pallas_sampler.py",
+      "training/cst.py"}),  # cst: the PG update's input shift, not a loop
+]
+
+
+class TestSingleDefinitionSite:
+    def test_consumers_import_the_shared_step(self):
+        from cst_captioning_tpu.decoding import beam
+        from cst_captioning_tpu.models import captioner
+        from cst_captioning_tpu.serving import slots
+        from cst_captioning_tpu.training import cst
+
+        for mod in (beam, captioner, slots, cst):
+            assert mod.decode_step is core.decode_step, mod.__name__
+
+    def test_no_second_definition_of_the_recurrence(self):
+        root = Path(cst_captioning_tpu.__file__).parent
+        offenders = []
+        for path in sorted(root.rglob("*.py")):
+            rel = path.relative_to(root).as_posix()
+            code = _code_only(path)
+            for pat, allowed in _FINGERPRINTS:
+                if pat.search(code) and rel not in allowed:
+                    offenders.append((rel, pat.pattern))
+        assert not offenders, (
+            "decode-step recurrence re-implemented outside "
+            f"decoding/core.py: {offenders} — import "
+            "cst_captioning_tpu.decoding.core.decode_step instead "
+            "(kernel bodies: extend the allowlist consciously)"
+        )

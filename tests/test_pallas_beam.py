@@ -218,28 +218,13 @@ class TestCaptionerIntegration:
         )
         return model, params, feats, masks, cat
 
-    @pytest.mark.parametrize("fusion", ["attention", "meanpool"])
-    @pytest.mark.parametrize("length_normalize", [True, False])
-    def test_token_exact_vs_scan_path(self, fusion, length_normalize):
-        fused, params, feats, masks, _ = self.build(True, fusion)
-        scan, *_ = self.build(False, fusion)
-        rf = beam_search(
-            fused, params, feats, masks, beam_size=4, max_len=9,
-            length_normalize=length_normalize,
-        )
-        rs = beam_search(
-            scan, params, feats, masks, beam_size=4, max_len=9,
-            length_normalize=length_normalize,
-        )
-        np.testing.assert_array_equal(
-            np.asarray(rf.all_tokens), np.asarray(rs.all_tokens)
-        )
-        np.testing.assert_allclose(
-            np.asarray(rf.all_scores), np.asarray(rs.all_scores),
-            rtol=1e-4, atol=1e-5,
-        )
+    # Token-exact fused-vs-scan parity (attention + meanpool), beam1 ==
+    # greedy, and the registry drive all moved to the SHARED harness:
+    # tests/test_decode_core.py ("fused_beam" backend vs "scan_beam").
 
     def test_category_model(self):
+        """Category embedding wiring is the one input surface the shared
+        harness ctx doesn't carry — keep the fused-vs-scan pin here."""
         fused, params, feats, masks, cat = self.build(
             True, use_category=True
         )
@@ -254,19 +239,6 @@ class TestCaptionerIntegration:
         )
         np.testing.assert_array_equal(
             np.asarray(rf.all_tokens), np.asarray(rs.all_tokens)
-        )
-
-    def test_beam1_equals_greedy_sample(self):
-        fused, params, feats, masks, _ = self.build(True)
-        r = beam_search(
-            fused, params, feats, masks, beam_size=1, max_len=6,
-            length_normalize=False,
-        )
-        g = fused.apply(
-            params, feats, masks, max_len=6, greedy=True, method="sample"
-        )
-        np.testing.assert_array_equal(
-            np.asarray(r.tokens), np.asarray(g.tokens)
         )
 
     def test_jitted_dispatch(self):
