@@ -38,7 +38,10 @@ serial-vs-pipelined CST reward-scheduling rows (subprocess CPU child;
 BENCH_CST_PIPE_BATCH / _ROLLOUTS / _WORKERS / _STEPS / _REPS size it),
 BENCH_CST_SLOT=0 to skip the paired padded-vs-slot CST rollout rows
 (subprocess CPU child; BENCH_CST_SLOT_BATCH / _ROLLOUTS / _L / _RNN /
-_EOS_BIAS / _BLOCK / _STEPS / _WARM size it),
+_EOS_BIAS / _BLOCK / _STEPS / _WARM size it), BENCH_SLOT_MEM=0 to skip
+the paired replicated-vs-deduped decode-state memory rows (subprocess
+CPU child; BENCH_SLOT_MEM_SLOTS / _CLIENTS / _REQS / _EOS_BIAS size
+it),
 BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -112,12 +115,20 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
         # *_per_sec / *_frac / vs_* field is a measurement by contract.
         measured_suffixes = ("_ms", "_per_sec", "_per_sec_chip", "_s",
                              "_frac", "_pct", "_ratio", "_speedup",
-                             "_steps_per_row", "_ticks")
+                             "_steps_per_row", "_ticks", "_bytes")
         for k, v in rec["extra"].items():
             if isinstance(v, bool) and (
                 k.endswith(measured_suffixes) or k.startswith("vs_")
             ):
                 fail(f"measured extra {k!r} is bool-typed")
+        # Memory accounting is exact pytree arithmetic by contract
+        # (ISSUE 7): any *_bytes field must be a real number — a bool,
+        # string, or None would mean nothing was measured.
+        for k, v in rec["extra"].items():
+            if k.endswith("_bytes") and not _is_number(v):
+                fail(
+                    f"{k!r} must be a numeric byte count, got {v!r}"
+                )
         # CPU-host caveats are machine-readable, not prose: any
         # *_host_cores field (cst_pipe_, serving_replicas_, cst_slot_,
         # ...) must be a real core count.
@@ -1326,6 +1337,238 @@ def bench_serving():
     return out
 
 
+def _bench_slot_mem_impl():
+    """Paired REPLICATED-vs-DEDUPED decode-state memory rows (ISSUE 7).
+
+    Decode-state bytes per in-flight request are DETERMINISTIC pytree
+    arithmetic — measured by summing the actual slot-state leaves of
+    both layouts (``SlotDecoder.state_bytes``), cross-checked against
+    the closed-form shape formula (``expected_state_bytes``; the delta
+    is recorded and must be 0) — so this row is machine-checked, not
+    wall-clock, and means the same thing on the CPU dev host and on
+    TPU.  Alongside: paired closed-loop captions/s + p99 at the same
+    offered load (both layouts, same weights/workload — wall-clock,
+    with the usual ``slot_mem_host_cores`` caveat), the elastic-bank
+    regrow count + worst regrow stall under a burst/idle drive, and the
+    capacity-at-fixed-memory-budget arithmetic (how many deduped slots
+    fit in the replicated bank's byte budget).
+
+    Env: BENCH_SLOT_MEM_SLOTS / _CLIENTS / _REQS / _EOS_BIAS size it."""
+    import threading
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.constants import EOS_ID
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.serving.batcher import ContinuousBatcher
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+    S = int(os.environ.get("BENCH_SLOT_MEM_SLOTS", "8"))
+    n_clients = int(os.environ.get("BENCH_SLOT_MEM_CLIENTS", "4"))
+    reqs_per_client = int(os.environ.get("BENCH_SLOT_MEM_REQS", "6"))
+    eos_bias = float(os.environ.get("BENCH_SLOT_MEM_EOS_BIAS", "3.0"))
+
+    cfg = get_preset("synthetic_smoke")
+    # Small-but-real CPU shape where the projected cache dominates the
+    # carry — the regime the dedup targets (MSR-VTT: cache ~93% of a
+    # beam-5 slot's bytes, docs/PERF.md r11).
+    cfg.model.rnn_size = 128
+    cfg.model.input_encoding_size = 128
+    cfg.model.att_hidden_size = 128
+    cfg.data.feature_dims = {"resnet": 256}
+    cfg.data.max_frames = 24
+    cfg.eval.beam_size = 3
+    cfg.eval.max_decode_len = 16
+    vocab = Vocabulary([f"w{i}" for i in range(1020)])
+    cfg.model.vocab_size = len(vocab)
+    cfg.serving.max_batch_size = S
+    cfg.serving.batch_shapes = []   # default power-of-two ladder
+    cfg.serving.num_slots = S
+    cfg.serving.queue_depth = 4096
+    cfg.serving.warmup = False          # slot-loop warmup only, below
+    cfg.serving.slot_block_steps = 1
+    K, L = cfg.eval.beam_size, cfg.eval.max_decode_len
+
+    def build(dedup: bool, bank_min: int = 0):
+        c = cfg.replace(**{
+            "serving.dedup_cache": dedup,
+            "serving.slot_bank_min": bank_min,
+            "serving.slot_shrink_idle_ticks": 3,
+        })
+        eng = InferenceEngine(c, random_init=True, vocab=vocab)
+        b = np.asarray(eng.params["params"]["logit_b"]).copy()
+        b[EOS_ID] += eos_bias           # recorded: random weights never
+        p = dict(eng.params)            # EOS without it (cst_slot
+        pp = dict(p["params"])          # precedent)
+        pp["logit_b"] = jnp.asarray(b)
+        p["params"] = pp
+        eng.params = p
+        dec = eng.slot_decoder()
+        dec.warmup()
+        return eng, dec
+
+    rng = np.random.RandomState(23)
+    F = cfg.data.max_frames
+    pool = [
+        {
+            "features": {
+                m: rng.randn(F, d).astype(np.float32)
+                for m, d in cfg.data.feature_dims.items()
+            }
+        }
+        for _ in range(n_clients * reqs_per_client)
+    ]
+
+    def run_closed(eng):
+        eng.cache.captions.clear()
+        metrics = ServingMetrics()
+        lat_ms, errors = [], []
+        lock = threading.Lock()
+
+        def client(cid):
+            for j in range(reqs_per_client):
+                k = cid * reqs_per_client + j
+                t0 = time.perf_counter()
+                try:
+                    batcher.submit(pool[k], deadline_ms=120_000.0)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        with ContinuousBatcher(eng, metrics) as batcher:
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        return {
+            "captions_per_sec": round(len(lat_ms) / wall, 2)
+            if wall > 0 else 0.0,
+            "p99_ms": round(np.percentile(lat_ms, 99), 2)
+            if lat_ms else 0.0,
+            "mean_steps": round(
+                metrics.steps_per_caption.snapshot()["mean_ms"], 2
+            ),
+            "errors": len(errors),
+        }
+
+    out = {"slot_mem_slots": S, "slot_mem_K": K, "slot_mem_L": L,
+           "slot_mem_eos_bias": eos_bias}
+
+    # ------- exact byte accounting, both layouts, same config --------
+    eng_d, dec_d = build(dedup=True)
+    eng_r, dec_r = build(dedup=False)
+    for tag, dec in (("dedup", dec_d), ("replicated", dec_r)):
+        out[f"slot_mem_{tag}_state_bytes"] = dec.state_bytes()
+        out[f"slot_mem_{tag}_bytes_per_request"] = dec.per_slot_bytes()
+        # Machine check: measured pytree bytes == closed-form formula.
+        out[f"slot_mem_{tag}_formula_delta_bytes"] = (
+            dec.state_bytes() - dec.expected_state_bytes()
+        )
+    out["slot_mem_bytes_per_request_ratio"] = round(
+        dec_r.per_slot_bytes() / dec_d.per_slot_bytes(), 3
+    )
+    out["slot_mem_cache_bytes_ratio"] = round(
+        dec_r.cache_bytes() / dec_d.cache_bytes(), 3
+    )
+    # Capacity at a fixed memory budget: deduped slots that fit in the
+    # replicated bank's bytes (the elastic top bank a deploy could set).
+    out["slot_mem_slots_at_replicated_budget"] = int(
+        dec_r.state_bytes() // dec_d.per_slot_bytes()
+    )
+
+    # ------------ paired load, same offered pattern ------------------
+    pt_d = run_closed(eng_d)
+    pt_r = run_closed(eng_r)
+    out.update({
+        "slot_mem_dedup_captions_per_sec": pt_d["captions_per_sec"],
+        "slot_mem_replicated_captions_per_sec": pt_r["captions_per_sec"],
+        "slot_mem_dedup_p99_ms": pt_d["p99_ms"],
+        "slot_mem_replicated_p99_ms": pt_r["p99_ms"],
+        "slot_mem_throughput_ratio": round(
+            pt_d["captions_per_sec"] / pt_r["captions_per_sec"], 3
+        ) if pt_r["captions_per_sec"] else None,
+        "slot_mem_mean_steps": pt_d["mean_steps"],
+        "slot_mem_dropped_live": pt_d["errors"] + pt_r["errors"],
+    })
+
+    # ------------- elastic regrow under burst/idle drive --------------
+    eng_e, dec_e = build(dedup=True, bank_min=max(2, S // 4))
+    compiles_after_warmup = dec_e.compile_count
+    prepared = [eng_e.prepare(q) for q in pool]
+    pending = list(range(len(prepared)))
+    while pending or dec_e.occupied:
+        dec_e.maybe_resize(len(pending))
+        n = min(len(pending), len(dec_e.free), dec_e.admit_cap)
+        adm = [pending.pop(0) for _ in range(n)]
+        done = dec_e.tick([prepared[i] for i in adm], adm)
+        dec_e.harvest_many(done)
+    for _ in range(dec_e.shrink_after * (len(dec_e.bank_ladder) + 1)):
+        dec_e.maybe_resize(0)       # idle: walk the ladder back down
+    out.update({
+        "slot_mem_bank_min": dec_e.bank_ladder[0],
+        "slot_mem_bank_max": dec_e.bank_ladder[-1],
+        "slot_mem_bank_final": dec_e.S,
+        "slot_mem_regrow_count": dec_e.resize_count,
+        "slot_mem_regrow_worst_ms": round(dec_e.worst_resize_ms, 3),
+        # 0 = every transition was a pre-jitted ladder hit (no cold
+        # retrace on the request path).
+        "slot_mem_regrow_new_compiles": (
+            dec_e.compile_count - compiles_after_warmup
+        ),
+    })
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except AttributeError:
+        cores = os.cpu_count() or 1
+    out["slot_mem_host_cores"] = cores
+    return out
+
+
+def bench_slot_mem():
+    """Replicated-vs-deduped decode-state pair (see
+    :func:`_bench_slot_mem_impl`).  Always re-execs into a subprocess
+    pinned to the in-process CPU backend — the byte accounting is
+    deterministic arithmetic that means the same thing everywhere, and
+    the wall-clock pairing targets the smoke shape by design (the
+    bench_cst_slot precedent), so it must run in degraded mode too."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SLOT_MEM_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"slot mem child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    return json.loads(lines[-1])
+
+
 def _bench_serving_replicas_impl():
     """Multi-replica serving sweep body (see bench_serving_replicas).
 
@@ -1909,6 +2152,15 @@ def main() -> int:
         except Exception as e:
             extra["decode_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_SLOT_MEM", "1") == "1":
+        # Paired replicated-vs-deduped decode-state memory rows
+        # (subprocess on the in-process CPU backend; the byte rows are
+        # deterministic pytree arithmetic — degraded-mode safe).
+        try:
+            extra.update(bench_slot_mem())
+        except Exception as e:  # noqa: BLE001
+            extra["slot_mem_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if os.environ.get("BENCH_SERVING", "1") == "1":
         # Serving subsystem sweep (serving/): needs a live jax backend
         # but drops to the CPU-sized shape off-TPU, so it runs in
@@ -1988,6 +2240,12 @@ if __name__ == "__main__":
         # Re-exec'd padded-vs-slot CST rollout child (bench_cst_slot).
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_cst_slot_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_SLOT_MEM_CHILD") == "1":
+        # Re-exec'd replicated-vs-deduped decode-state child
+        # (bench_slot_mem).
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_slot_mem_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_REPLICA_CHILD") == "1":
         # Re-exec'd replica-sweep child (bench_serving_replicas): the

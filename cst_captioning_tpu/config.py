@@ -253,8 +253,32 @@ class ServingConfig:
     # boundary.  False = the PR-2 batch-at-a-time shape ladder.
     continuous: bool = True
     # Decode slots for continuous mode (greedy: 1 row/slot; beam: K
-    # contiguous rows/slot).  0 = max_batch_size.
+    # contiguous rows/slot).  0 = max_batch_size.  With an elastic bank
+    # ladder (slot_bank_min > 0) this is the TOP bank.
     num_slots: int = 0
+    # Beam-deduplicated decode-state cache (serving/slots.py): store the
+    # read-only projected encoder DecodeCache ONCE per slot ((S, ...)
+    # leaves) instead of once per beam row ((S*K, ...)); the jitted step
+    # reads the shared copy via the row->slot index.  Cuts decode-state
+    # HBM per in-flight beam request ~K x with token-exact output (the
+    # replicated rows were identical copies).  False keeps the legacy
+    # replicated layout (paired bench rows / regression escape hatch).
+    dedup_cache: bool = True
+    # Elastic slot-bank ladder: 0 = one fixed bank of num_slots (the
+    # PR-3 behavior).  > 0 pages the slot matrix through a pre-jitted
+    # doubling ladder [min, 2*min, ..., num_slots]; the decoder grows
+    # banks under queue pressure and shrinks after
+    # slot_shrink_idle_ticks consecutive underfull ticks — capacity
+    # follows traffic with no cold-retrace stall (every transition is
+    # compiled at warmup).
+    slot_bank_min: int = 0
+    # Consecutive underfull ticks (occupancy + queue fits the next bank
+    # down) before an elastic shrink; hysteresis against thrash.
+    slot_shrink_idle_ticks: int = 8
+    # Zero freed/evicted slots' cache + carry rows at free time (one
+    # fused mask-select per harvest batch) so the live decode-state
+    # byte gauges report resident state honestly, not stale rows.
+    zero_freed_slots: bool = True
     # Data-parallel engine replicas (serving/replicas.py): one warm
     # engine + slot decoder per replica, weights device_put once per
     # replica, a least-loaded router in front.  1 = the single-replica
@@ -455,6 +479,10 @@ def _preset_msrvtt_serve() -> Config:
     # at 256MiB of host RAM regardless of entry count.
     c.serving.feature_cache_bytes = 256 * 1024 * 1024
     c.serving.num_slots = 64
+    # Elastic decode-state capacity: page the slot matrix through the
+    # pre-jitted 8 -> 16 -> 32 -> 64 bank ladder so quiet replicas hold
+    # an 8-slot bank's worth of decode-state HBM, not 64 slots' worth.
+    c.serving.slot_bank_min = 8
     # Production default: replicate the engine over every local chip
     # (serving/replicas.py) with double-buffered dispatch.
     c.serving.replicas = 0

@@ -414,8 +414,8 @@ class ContinuousBatcher(_BatcherBase):
     def _loop(self) -> None:
         decoder = self.engine.slot_decoder()
         self.metrics.slots_total.set(decoder.S)
+        self.metrics.slot_bank_size.set(decoder.S)
         drain_deadline: Optional[float] = None
-        admit_max = min(decoder.admit_cap, decoder.S)
         while True:
             admits: List[_Pending] = []
             with self._cond:
@@ -434,7 +434,21 @@ class ContinuousBatcher(_BatcherBase):
                         drain_deadline = (
                             time.monotonic() + self.drain_timeout_s
                         )
-                cap = min(len(decoder.free), admit_max)
+                # Elastic slot banks: let the decoder follow queue
+                # pressure at the tick boundary (pre-jitted transitions,
+                # a no-op with a single fixed bank).
+                before = decoder.resize_count
+                decoder.maybe_resize(len(self._q))
+                if decoder.resize_count != before:
+                    self.metrics.slot_bank_resizes.inc(
+                        decoder.resize_count - before
+                    )
+                    self.metrics.slots_total.set(decoder.S)
+                    self.metrics.slot_bank_size.set(decoder.S)
+                cap = min(
+                    len(decoder.free),
+                    min(decoder.admit_cap, decoder.S),
+                )
                 while self._q and len(admits) < cap:
                     admits.append(self._q.popleft())
             if (
@@ -481,6 +495,9 @@ class ContinuousBatcher(_BatcherBase):
             if done:
                 self._resolve(decoder.harvest_many(done))
                 self.metrics.slots_occupied.set(decoder.n_occupied)
+            self.metrics.decode_state_bytes.set(
+                decoder.live_state_bytes()
+            )
 
         # Hard stop (drain=False): fail whatever is still in flight;
         # queued requests are failed by stop() after the join.

@@ -147,6 +147,11 @@ class InferenceEngine:
         self._state_fns: Dict[int, Any] = {}
         self._fused_at: Dict[int, bool] = {}
         self._slot_decoder = None
+        # Admission-encode accounting (scheduler thread only): rows
+        # admitted from tier-2 cached encoder state vs rows that paid
+        # the encode — the zero-recompute contract is testable.
+        self.admit_rows_cached = 0
+        self.admit_rows_encoded = 0
         # Data-parallel replica identity (serving/replicas.py): the
         # device this engine's weights are committed to, or None for
         # the default single-engine placement.
@@ -519,34 +524,76 @@ class InferenceEngine:
     ) -> DecodeCache:
         """The slot loop's admission encode: (B, ...) projected encoder
         rows for one admission batch, B = len(reqs) (the loop pads the
-        batch to a compiled bucket itself).  When EVERY request carries
-        tier-2 rows the encode is skipped outright (host stack +
-        upload); otherwise ONE jitted ``init_decode`` — the same encode
-        the offline paths run — covers the whole batch, and rows are
-        stored back into tier 2 for requests with a ``feature_id``."""
-        if all(r.enc_row is not None for r in reqs):
+        batch to a compiled bucket itself).
+
+        Tier-2 hits admit with ZERO encoder recompute: a request that
+        carries cached ``DecodeCache`` rows contributes them directly
+        (host stack + upload — no projection GEMMs), and only the MISS
+        rows run the jitted ``init_decode`` — the same encode the
+        offline paths run — at a padded power-of-two bucket.  Since the
+        tier-2 cache is shared across replicas under one ``params_tag``,
+        a row encoded by ANY replica admits hit-free on every other
+        replica.  Fresh rows are stored back into tier 2 for requests
+        with a ``feature_id``.  ``admit_rows_encoded`` /
+        ``admit_rows_cached`` count both paths (scheduler thread only)."""
+        miss = [i for i, r in enumerate(reqs) if r.enc_row is None]
+        self.admit_rows_cached += len(reqs) - len(miss)
+        self.admit_rows_encoded += len(miss)
+        if not miss:
             return DecodeCache(*(
                 jnp.asarray(np.stack([np.asarray(r.enc_row[f]) for r in reqs]))
                 for f in range(len(reqs[0].enc_row))
             ))
-        feats = {
-            m: jnp.asarray(np.stack([r.feats[m] for r in reqs]))
-            for m in self.cfg.data.feature_modalities
-        }
-        masks = {
-            m: jnp.asarray(np.stack([r.masks[m] for r in reqs]))
-            for m in self.cfg.data.feature_modalities
-        }
-        cat = (
-            jnp.asarray(
-                np.asarray([r.category for r in reqs], np.int32)
+
+        def encode(subset: Sequence[PreparedRequest]) -> DecodeCache:
+            feats = {
+                m: jnp.asarray(np.stack([r.feats[m] for r in subset]))
+                for m in self.cfg.data.feature_modalities
+            }
+            masks = {
+                m: jnp.asarray(np.stack([r.masks[m] for r in subset]))
+                for m in self.cfg.data.feature_modalities
+            }
+            cat = (
+                jnp.asarray(
+                    np.asarray([r.category for r in subset], np.int32)
+                )
+                if self.model.use_category
+                else None
             )
-            if self.model.use_category
-            else None
+            cache = self._encode_fn(len(subset))(
+                self.params, feats, masks, cat
+            )
+            self._store_enc_rows(subset, cache)
+            return cache
+
+        if len(miss) == len(reqs):
+            return encode(reqs)
+        # Mixed batch: encode only the misses, padded up to a
+        # power-of-two bucket (replicating the last miss) so the jit
+        # cache stays bounded, then splice encoded and cached rows back
+        # into request order on the host — the tier-2 values are host
+        # numpy by design, so the splice costs one fetch of the fresh
+        # rows and no extra device compute.
+        Bm = 1
+        while Bm < len(miss):
+            Bm *= 2
+        subset = [reqs[i] for i in miss]
+        subset += [subset[-1]] * (Bm - len(miss))
+        fresh = tuple(
+            np.asarray(jax.device_get(f)) for f in encode(subset)
         )
-        cache = self._encode_fn(len(reqs))(self.params, feats, masks, cat)
-        self._store_enc_rows(reqs, cache)
-        return cache
+        pos = {ri: mi for mi, ri in enumerate(miss)}
+        rows = []
+        for i, r in enumerate(reqs):
+            if r.enc_row is not None:
+                rows.append(tuple(np.asarray(f) for f in r.enc_row))
+            else:
+                rows.append(tuple(f[pos[i]] for f in fresh))
+        return DecodeCache(*(
+            jnp.asarray(np.stack([row[f] for row in rows]))
+            for f in range(len(rows[0]))
+        ))
 
     def template_prepared(self) -> PreparedRequest:
         """A valid all-zeros request row (warmup traffic)."""
@@ -643,6 +690,8 @@ class InferenceEngine:
             "num_slots": int(
                 self.cfg.serving.num_slots or self.max_batch
             ),
+            "dedup_cache": bool(self.cfg.serving.dedup_cache),
+            "slot_bank_min": int(self.cfg.serving.slot_bank_min),
             "modalities": {
                 m: self.cfg.data.feature_dims[m]
                 for m in self.cfg.data.feature_modalities

@@ -161,6 +161,11 @@ class ReplicaMetrics:
         self.captions_total = Counter()  # rate() -> captions/s
         self.admitted_total = Counter()
         self.steps_total = Counter()     # device decode steps run
+        # Decode-state memory (PR 7): live bytes of the replica's slot
+        # pytree (occupied slots only — freed rows are zeroed) and the
+        # current elastic bank size.
+        self.decode_state_bytes = Gauge()
+        self.slot_bank_size = Gauge()
 
 
 class ServingMetrics:
@@ -184,6 +189,12 @@ class ServingMetrics:
         self.slots_occupied = Gauge()       # live slots right now
         self.slots_admitted_total = Counter()   # admissions into slots
         self.slot_steps_total = Counter()   # device decode steps run
+        # Decode-state memory (PR 7): live bytes of the resident slot
+        # pytree(s) and the current elastic slot-bank size (summed /
+        # single-replica; per-replica twins live on ReplicaMetrics).
+        self.decode_state_bytes = Gauge()
+        self.slot_bank_size = Gauge()
+        self.slot_bank_resizes = Counter()  # elastic grow/shrink events
         # Decode steps each caption actually paid before its slot freed.
         self.steps_per_caption = LatencyHistogram(STEP_BUCKETS)
         # Per-replica label sets, created on first use (replica ids are
@@ -232,6 +243,9 @@ class ServingMetrics:
                 "admitted": self.slots_admitted_total.value,
                 "device_steps": self.slot_steps_total.value,
                 "steps_per_caption": self.steps_per_caption.snapshot(),
+                "decode_state_bytes": self.decode_state_bytes.value,
+                "bank_size": self.slot_bank_size.value,
+                "bank_resizes": self.slot_bank_resizes.value,
             },
             "latency_ms": {s: h.snapshot() for s, h in self.stages.items()},
         }
@@ -249,6 +263,8 @@ class ServingMetrics:
                     ),
                     "admitted": rm.admitted_total.value,
                     "device_steps": rm.steps_total.value,
+                    "decode_state_bytes": rm.decode_state_bytes.value,
+                    "slot_bank_size": rm.slot_bank_size.value,
                 }
                 for rid, rm in reps
             }
@@ -271,6 +287,7 @@ class ServingMetrics:
             "caption_batch_pad_rows_total": self.batch_pad_rows_total,
             "caption_slots_admitted_total": self.slots_admitted_total,
             "caption_slot_device_steps_total": self.slot_steps_total,
+            "caption_slot_bank_resizes_total": self.slot_bank_resizes,
         }
         for name, c in counters.items():
             lines.append(f"# TYPE {name} counter")
@@ -278,6 +295,8 @@ class ServingMetrics:
         for name, g in (
             ("caption_slots_total", self.slots_total),
             ("caption_slots_occupied", self.slots_occupied),
+            ("caption_decode_state_bytes", self.decode_state_bytes),
+            ("caption_slot_bank_size", self.slot_bank_size),
         ):
             lines.append(f"# TYPE {name} gauge")
             lines.append(f"{name} {g.value}")
@@ -296,6 +315,10 @@ class ServingMetrics:
                  lambda rm: rm.admitted_total.value),
                 ("caption_replica_device_steps_total", "counter",
                  lambda rm: rm.steps_total.value),
+                ("caption_replica_decode_state_bytes", "gauge",
+                 lambda rm: rm.decode_state_bytes.value),
+                ("caption_replica_slot_bank_size", "gauge",
+                 lambda rm: rm.slot_bank_size.value),
             )
             for name, typ, read in families:
                 lines.append(f"# TYPE {name} {typ}")

@@ -285,7 +285,7 @@ class ReplicaSet(_BatcherBase):
     def _worker_loop(self, rep: Replica) -> None:
         decoder = rep.decoder
         rm = self.metrics.replica(rep.rid)
-        admit_max = min(decoder.admit_cap, decoder.S)
+        rm.slot_bank_size.set(decoder.S)
         outstanding = None          # un-waited TickHandle (double buffer)
         drain_deadline: Optional[float] = None
         while True:
@@ -314,7 +314,26 @@ class ReplicaSet(_BatcherBase):
                         drain_deadline = (
                             time.monotonic() + self.drain_timeout_s
                         )
-                cap = min(len(decoder.free), admit_max)
+                # Elastic slot banks per replica: grow under this
+                # replica's queue pressure, shrink when idle.  A resize
+                # is a pre-jitted prefix copy at the tick boundary;
+                # outstanding double-buffered handles stay harvestable
+                # (they carry their own output arrays, and the
+                # admit-tick guard bounds their slot indices).
+                before = decoder.resize_count
+                decoder.maybe_resize(len(rep.q))
+                if decoder.resize_count != before:
+                    self.metrics.slot_bank_resizes.inc(
+                        decoder.resize_count - before
+                    )
+                    rm.slot_bank_size.set(decoder.S)
+                    self.metrics.slots_total.set(sum(
+                        r.decoder.S for r in self.replicas if r.healthy
+                    ))
+                cap = min(
+                    len(decoder.free),
+                    min(decoder.admit_cap, decoder.S),
+                )
                 while rep.q and len(admits) < cap:
                     admits.append(rep.q.popleft())
                 rm.queue_depth.set(len(rep.q))
@@ -376,6 +395,7 @@ class ReplicaSet(_BatcherBase):
                         rep, rm, decoder.harvest_from(to_wait, done)
                     )
                     rm.slots_occupied.set(decoder.n_occupied)
+            rm.decode_state_bytes.set(decoder.live_state_bytes())
 
         # Hard stop (drain=False): fail whatever is still in flight;
         # queued requests are failed by stop() after the join.

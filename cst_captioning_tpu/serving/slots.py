@@ -8,7 +8,7 @@ most of the wait is head-of-line blocking.  This module holds the
 standard production fix (continuous batching at decode-step
 granularity):
 
-* a fixed matrix of ``S`` decode slots — greedy: 1 row/slot, beam: K
+* a matrix of ``S`` decode slots — greedy: 1 row/slot, beam: K
   contiguous rows/slot — whose per-slot state (``DecodeState`` rows,
   projected ``DecodeCache`` rows, emitted tokens, beam scores, finished
   flags, step counter) lives on device as one pytree of static shapes;
@@ -22,16 +22,55 @@ granularity):
   (host-side, from the tick's own outputs — no extra device call) and
   freed, so a short caption exits in ~its-own-length steps.
 
+Decode-state memory (PR 7).  The projected ``DecodeCache`` is READ-ONLY
+across decode steps, and a beam slot's K rows decode the SAME video —
+the replicated ``(S*K, ...)`` cache layout stored K byte-identical
+copies per request.  With ``serving.dedup_cache`` (default) the cache
+is stored ONCE per slot (``(S, ...)`` leaves) and the jitted step
+gathers per-row cache views via the row→slot index ``row // K`` before
+calling ``decode_logits`` — the gather is transient scratch inside the
+step, while the PERSISTENT decode-state HBM per in-flight beam request
+drops ~K× (exact byte arithmetic: :meth:`SlotDecoder.state_bytes` /
+:meth:`SlotDecoder.expected_state_bytes`, machine-checked in tier-1).
+The cache rows were identical copies, and every decode op is
+row-independent, so reading the shared copy cannot change any token
+(docs/PARITY.md).  ``dedup_cache=false`` keeps the replicated layout —
+the paired ``slot_mem_*`` bench rows measure both pytrees honestly, and
+both layouts register in the shared parity harness.
+
+Elastic slot banks (PR 7).  ``serving.slot_bank_min > 0`` pages the
+slot matrix through a small pre-jitted doubling LADDER of bank shapes
+(``[min, 2·min, ..., num_slots]`` — the PR-2 batch-ladder pattern): at
+tick boundaries :meth:`SlotDecoder.maybe_resize` grows the bank while
+queue pressure exceeds free slots and shrinks it after
+``slot_shrink_idle_ticks`` consecutive underfull ticks.  Admission
+fills the LOWEST free slot first, so high banks drain naturally and a
+shrink only ever drops FREE slots (occupied rows are never moved —
+resizing copies the surviving prefix, so it cannot change any row's
+numbers).  Every tick variant and bank transition is compiled at
+:meth:`warmup`, so a regrow under traffic is a pre-jitted ladder hit —
+no cold-retrace stall on the request path
+(``SlotDecoder.compile_count`` pins this in tier-1).  Capacity ``S``
+becomes a knob that follows traffic instead of a deploy-time constant
+(ROADMAP open item 3).
+
+Freed/evicted slots have their cache and carry rows ZEROED at free time
+(``serving.zero_freed_slots``, one fused mask-select per harvest
+batch), so the live-byte gauges (``caption_decode_state_bytes``,
+``caption_slot_bank_size``) report what is actually resident, not
+stale rows riding dead in the bank.
+
 Host-overhead discipline: with short captions, admissions and harvests
 happen roughly once per caption, so per-request device dispatches would
 dominate the step loop.  The loop therefore pays a CONSTANT number of
 dispatches per iteration: admission is batched (one padded-bucket
 encode, scatter fused into the step call, one compiled variant per
-admission-count bucket) and harvest reads the (tiny) token/score
-matrices the tick already returned.
+admission-count bucket per bank) and harvest reads the (tiny)
+token/score matrices the tick already returned.
 
 Parity argument (the bar: slot-decoded captions are token-exact vs the
-offline ``evaluation.py`` path, pinned by tests/test_serving.py):
+offline ``evaluation.py`` path, pinned by tests/test_serving.py and the
+shared harness in tests/test_decode_core.py):
 
 * The per-step math IS the unified decode core — the very same
   ``decoding/core.py::decode_step`` the offline scan beam
@@ -42,6 +81,10 @@ offline ``evaluation.py`` path, pinned by tests/test_serving.py):
   shared scan index.  Every op is row-independent, so which OTHER
   requests share the matrix (or arrive later — admission order) cannot
   change any row's numbers.
+* The deduped cache read ``cache[row // K]`` yields bitwise the same
+  per-row tensors the replicated layout stored — K identical copies
+  collapse to one — so dedup cannot change any logit, and neither can
+  a bank resize (prefix copy) or a freed-row zeroing (dead rows only).
 * A finished slot that keeps riding (until harvest, or the remainder of
   a step block) is frozen exactly like the offline scan's finished
   beams: its only continuation is PAD at zero cost, a no-op on
@@ -70,7 +113,10 @@ device compute.  Two guards keep that reordering exact:
 * ``admit_tick`` records the tick at which each slot's occupant was
   admitted, and ``tick_wait(handle)`` only reports slots admitted at or
   before ``handle.seq`` — a slot harvested-then-refilled between
-  dispatch and wait can never be harvested from a stale handle.
+  dispatch and wait can never be harvested from a stale handle.  (The
+  same guard makes bank resizes safe between dispatch and wait: a slot
+  admitted into a freshly-grown bank carries a later ``admit_tick``
+  than any outstanding handle, and a shrink only drops free slots.)
 
 A finished slot rides frozen for the extra buffered tick (PAD-only
 continuation, a no-op on tokens/scores — the same parity argument as
@@ -85,7 +131,9 @@ nothing here locks.
 
 from __future__ import annotations
 
+import bisect
 import logging
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax
@@ -114,6 +162,16 @@ def _buckets(top: int) -> List[int]:
     return out
 
 
+def _bank_ladder(lo: int, hi: int) -> List[int]:
+    """Doubling ladder of slot-bank sizes ``[lo, 2·lo, ..., hi]``."""
+    lo = max(1, min(int(lo), int(hi)))
+    out, b = [lo], lo
+    while b < hi:
+        b = min(b * 2, hi)
+        out.append(b)
+    return out
+
+
 class TickHandle(NamedTuple):
     """One dispatched (possibly un-synced) tick: its sequence number and
     its own output arrays.  ``done``/``seqs``/``scores`` are the jitted
@@ -129,10 +187,12 @@ class SlotState(NamedTuple):
     """Device-resident state of all S decode slots: the unified decode
     carry (``decoding/core.py::CoreState``, per-slot axes ``(S, K,
     ...)``, flat row axis ``S*K``) plus the projected ``DecodeCache``
-    rows the step closes over."""
+    rows the step closes over — deduped to ONE row per slot (leaves
+    lead with S) under ``serving.dedup_cache``, or the legacy
+    replicated per-beam-row layout (leaves lead with S*K)."""
 
     core: CoreState       # seqs/scores/finished/tokens/step + (h, c)
-    cache: DecodeCache    # leaves lead with S*K
+    cache: DecodeCache    # leaves lead with S (dedup) or S*K
 
 
 class SlotDecoder:
@@ -145,16 +205,29 @@ class SlotDecoder:
         self.greedy = engine.decode_mode == "greedy"
         self.K = 1 if self.greedy else cfg.eval.beam_size
         self.L = cfg.eval.max_decode_len
-        self.S = int(sv.num_slots or engine.max_batch)
-        if self.S < 1:
-            raise ValueError(f"num_slots {self.S} < 1")
+        self.S_max = int(sv.num_slots or engine.max_batch)
+        if self.S_max < 1:
+            raise ValueError(f"num_slots {self.S_max} < 1")
+        self.dedup = bool(getattr(sv, "dedup_cache", True))
+        self.zero_freed = bool(getattr(sv, "zero_freed_slots", True))
+        bank_min = int(getattr(sv, "slot_bank_min", 0) or 0)
+        self.bank_ladder = (
+            _bank_ladder(bank_min, self.S_max)
+            if bank_min > 0 else [self.S_max]
+        )
+        self.shrink_after = max(
+            1, int(getattr(sv, "slot_shrink_idle_ticks", 8))
+        )
+        # Elastic mode starts at the smallest bank — capacity follows
+        # traffic; warmup pre-compiles every bank and transition.
+        self.S = self.bank_ladder[0]
         self.block = max(1, int(sv.slot_block_steps))
         self.length_normalize = cfg.eval.length_normalize
         self.model = engine.model
         self.V = self.model.vocab_size
         # Admissions per tick are capped so the padded admission-encode
         # bucket stays within the engine's compiled shape discipline.
-        self.admit_cap = min(self.S, engine.max_batch)
+        self.admit_cap = min(self.S_max, engine.max_batch)
         self._admit_buckets = _buckets(self.admit_cap)
         if getattr(self.model, "use_pallas_beam", False):
             # The fused whole-recurrence kernel decodes run-to-completion
@@ -164,12 +237,25 @@ class SlotDecoder:
                 "fused beam kernel (use_pallas_beam) applies to the "
                 "ladder/offline paths only"
             )
-        # Host-side slot bookkeeping (scheduler thread only).
+        # Host-side slot bookkeeping (scheduler thread only).  ``free``
+        # stays SORTED and admission takes the LOWEST index, so high
+        # slots drain first and a bank shrink only drops free slots.
         self.free: List[int] = list(range(self.S))
         self.occupied: Dict[int, Any] = {}      # slot -> caller's data
         self.admit_tick: Dict[int, int] = {}    # slot -> admission seq
-        self._tick_fns: Dict[int, Any] = {}
+        self._tick_fns: Dict[Tuple[int, int], Any] = {}   # (S, A) -> fn
+        self._resize_fns: Dict[Tuple[int, int], Any] = {}
+        self._free_fns: Dict[int, Any] = {}               # S -> fn
         self._seq = 0                           # dispatched-tick counter
+        # Compiled-variant builds (tick/resize/free fns): warmup builds
+        # them all, so post-warmup traffic — including bank regrows —
+        # must never build a new one (the pre-jitted-ladder pin).
+        self.compile_count = 0
+        # Bank-resize accounting (metrics / bench regrow rows).
+        self.resize_count = 0
+        self.last_resize_ms = 0.0
+        self.worst_resize_ms = 0.0
+        self._shrink_streak = 0
         # Last dispatched handle (sync-path harvest target) and a host
         # snapshot cache keyed by handle seq (fetched lazily, at most
         # once per handle).
@@ -178,23 +264,29 @@ class SlotDecoder:
         self._seqs_np: Optional[np.ndarray] = None
         self._scores_np: Optional[np.ndarray] = None
         self._build_step()
-        self._st = self._init_state()
+        self._st = self._init_state(self.S)
 
     # ------------------------------------------------------------- device
-    def _init_state(self) -> SlotState:
-        model, S, K, L = self.model, self.S, self.K, self.L
+    def _cache_rows(self, S: int) -> int:
+        """Leading dim of the stored DecodeCache: one row per slot when
+        deduped, one per beam row in the legacy replicated layout."""
+        return S if self.dedup else S * self.K
+
+    def _init_state(self, S: int) -> SlotState:
+        model, K, L = self.model, self.K, self.L
         cdt = jnp.dtype(model.compute_dtype)
         n = S * K
+        nc = self._cache_rows(S)
         d = self.engine.cfg.data
         # Zero cache rows shaped exactly like one encode output: let
-        # eval_shape infer the (S*K, ...) DecodeCache leaf shapes.
+        # eval_shape infer the (nc, ...) DecodeCache leaf shapes.
         feats = {
-            m: jnp.zeros((n, d.max_frames, d.feature_dims[m]))
+            m: jnp.zeros((nc, d.max_frames, d.feature_dims[m]))
             for m in d.feature_modalities
         }
-        masks = {m: jnp.ones((n, d.max_frames)) for m in feats}
+        masks = {m: jnp.ones((nc, d.max_frames)) for m in feats}
         cat = (
-            jnp.zeros((n,), jnp.int32) if model.use_category else None
+            jnp.zeros((nc,), jnp.int32) if model.use_category else None
         )
         cache_shape = jax.eval_shape(
             lambda f, mk, c: model.apply(
@@ -228,7 +320,7 @@ class SlotDecoder:
         return st if dev is None else jax.device_put(st, dev)
 
     def _build_step(self) -> None:
-        model, K = self.model, self.K
+        model, K, dedup = self.model, self.K, self.dedup
         mode = "greedy" if self.greedy else "beam"
 
         def step_once(params, st: SlotState) -> SlotState:
@@ -237,8 +329,17 @@ class SlotDecoder:
             # offline scan paths, only the batch axis is the slot axis
             # and write positions are the per-slot step counters.
             def step_logits(state, tokens):
+                cache = st.cache
+                if dedup and K > 1:
+                    # Shared-copy read: row r of slot s sees cache[s].
+                    # The gather is scratch inside the step; the stored
+                    # state keeps ONE row per slot.
+                    row_slot = jnp.arange(state.h.shape[1]) // K
+                    cache = jax.tree.map(
+                        lambda x: x[row_slot], cache
+                    )
                 return model.apply(
-                    params, state, st.cache, tokens,
+                    params, state, cache, tokens,
                     method="decode_logits",
                 )
 
@@ -251,27 +352,32 @@ class SlotDecoder:
         ).astype(jnp.float32)[None, :]                          # (1, K)
 
     def _tick_fn(self, A: int):
-        """One compiled scheduler iteration: scatter A admissions into
-        their slots (A static per variant, 0 = pure step), then run the
-        step block.  Returns the new state plus everything the host
-        needs — done flags and the token/score matrices — so harvests
-        cost no extra device call."""
-        if A in self._tick_fns:
-            return self._tick_fns[A]
+        """One compiled scheduler iteration at the CURRENT bank size:
+        scatter A admissions into their slots (A static per variant,
+        0 = pure step), then run the step block.  Returns the new state
+        plus everything the host needs — done flags and the token/score
+        matrices — so harvests cost no extra device call."""
+        key = (self.S, A)
+        if key in self._tick_fns:
+            return self._tick_fns[key]
+        self.compile_count += 1
         model, K, L = self.model, self.K, self.L
+        dedup = self.dedup
         cdt = jnp.dtype(model.compute_dtype)
         scores0 = self._scores0
         step_once, block = self._step_once, self.block
 
-        def admit_one(st: SlotState, slot, rows_k: DecodeCache):
-            """Scatter one request's K beam rows into ``slot``."""
+        def admit_one(st: SlotState, slot, req_rows: DecodeCache):
+            """Scatter one request's cache rows — (1, ...) deduped, or
+            (K, ...) replicated — plus fresh carry into ``slot``."""
             row0 = slot * K
+            cache_off = slot if dedup else row0
             cache = jax.tree.map(
                 lambda leaf, r: jax.lax.dynamic_update_slice(
                     leaf, r.astype(leaf.dtype),
-                    (row0,) + (jnp.int32(0),) * (leaf.ndim - 1),
+                    (cache_off,) + (jnp.int32(0),) * (leaf.ndim - 1),
                 ),
-                st.cache, rows_k,
+                st.cache, req_rows,
             )
             co = st.core
             core = co._replace(
@@ -322,21 +428,24 @@ class SlotDecoder:
         @jax.jit
         def tick(params, st: SlotState, slots, rows: DecodeCache):
             if A:
-                # (A, ...) request rows -> (A*K, ...) beam rows, once.
-                rows = jax.tree.map(
-                    lambda x: jnp.repeat(x, K, axis=0), rows
-                )
+                if not dedup:
+                    # Legacy replicated layout only: fan each request's
+                    # row out to its K beam rows before the scatter.
+                    rows = jax.tree.map(
+                        lambda x: jnp.repeat(x, K, axis=0), rows
+                    )
+                R = 1 if dedup else K
                 for i in range(A):
-                    rows_k = jax.tree.map(
+                    req_rows = jax.tree.map(
                         lambda r: jax.lax.dynamic_slice(
                             r,
-                            (i * K,) + (0,) * (r.ndim - 1),
-                            (K,) + r.shape[1:],
+                            (i * R,) + (0,) * (r.ndim - 1),
+                            (R,) + r.shape[1:],
                         ),
                         rows,
                     )
                     st = admit_one(
-                        st, slots[i].astype(jnp.int32), rows_k
+                        st, slots[i].astype(jnp.int32), req_rows
                     )
             for _ in range(block):
                 st = step_once(params, st)
@@ -345,14 +454,250 @@ class SlotDecoder:
             )
             return st, done, st.core.seqs, st.core.scores
 
-        self._tick_fns[A] = tick
+        self._tick_fns[key] = tick
         return tick
+
+    def _free_fn(self, S: int):
+        """Compiled freed-slot blanking: reset the masked slots' cache
+        and carry rows to the empty-slot pattern (zeros / PAD / frozen)
+        so live decode-state bytes are honest.  One variant per bank —
+        the mask is a traced argument, not a shape."""
+        if S in self._free_fns:
+            return self._free_fns[S]
+        self.compile_count += 1
+        K, L = self.K, self.L
+        dedup = self.dedup
+
+        def bmask(mask, leaf):
+            return mask.reshape(mask.shape + (1,) * (leaf.ndim - 1))
+
+        @jax.jit
+        def free_rows(st: SlotState, mask):       # mask: (S,) bool
+            mask_n = jnp.reshape(
+                jnp.broadcast_to(mask[:, None], (S, K)), (S * K,)
+            )
+            mask_c = mask if dedup else mask_n
+            cache = jax.tree.map(
+                lambda x: jnp.where(
+                    bmask(mask_c, x), jnp.zeros((), x.dtype), x
+                ),
+                st.cache,
+            )
+            co = st.core
+            core = co._replace(
+                state=DecodeState(
+                    h=jnp.where(mask_n[None, :, None], 0.0, co.state.h),
+                    c=jnp.where(mask_n[None, :, None], 0.0, co.state.c),
+                ),
+                seqs=jnp.where(
+                    mask[:, None, None], jnp.int32(PAD_ID), co.seqs
+                ),
+                scores=(
+                    None if co.scores is None
+                    else jnp.where(mask[:, None], 0.0, co.scores)
+                ),
+                finished=co.finished | mask[:, None],
+                tokens=jnp.where(mask_n, jnp.int32(BOS_ID), co.tokens),
+                step=jnp.where(mask, jnp.int32(L), co.step),
+            )
+            return SlotState(core=core, cache=cache)
+
+        self._free_fns[S] = free_rows
+        return free_rows
+
+    def _zero_slots(self, slots: Sequence[int]) -> None:
+        if not self.zero_freed or not slots:
+            return
+        mask = np.zeros((self.S,), bool)
+        mask[list(slots)] = True
+        self._st = self._free_fn(self.S)(self._st, jnp.asarray(mask))
+
+    def _resize_fn(self, S_from: int, S_to: int):
+        """Compiled bank transition ``S_from -> S_to``: grow pads with
+        empty slots (finished / step=L / zero rows) after the surviving
+        prefix; shrink slices the prefix (callers guarantee slots >=
+        S_to are free).  Prefix rows are COPIED, never recomputed, so a
+        resize cannot change any in-flight row's numbers."""
+        key = (S_from, S_to)
+        if key in self._resize_fns:
+            return self._resize_fns[key]
+        self.compile_count += 1
+        K, L = self.K, self.L
+        grow = S_to > S_from
+
+        def scale(leaf, fill, axis=0):
+            shape = list(leaf.shape)
+            shape[axis] = (shape[axis] // S_from) * S_to
+            if grow:
+                big = jnp.full(tuple(shape), fill, leaf.dtype)
+                return jax.lax.dynamic_update_slice(
+                    big, leaf, (jnp.int32(0),) * leaf.ndim
+                )
+            ix = [slice(None)] * leaf.ndim
+            ix[axis] = slice(0, shape[axis])
+            return leaf[tuple(ix)]
+
+        @jax.jit
+        def resize(st: SlotState) -> SlotState:
+            co = st.core
+            cache = jax.tree.map(lambda x: scale(x, 0), st.cache)
+            core = co._replace(
+                state=DecodeState(
+                    h=scale(co.state.h, 0, axis=1),
+                    c=scale(co.state.c, 0, axis=1),
+                ),
+                seqs=scale(co.seqs, PAD_ID),
+                scores=(
+                    None if co.scores is None else scale(co.scores, 0.0)
+                ),
+                finished=scale(co.finished, True),
+                tokens=scale(co.tokens, BOS_ID),
+                step=scale(co.step, L),
+            )
+            return SlotState(core=core, cache=cache)
+
+        self._resize_fns[key] = resize
+        return resize
 
     def _pad_bucket(self, n: int) -> int:
         for b in self._admit_buckets:
             if b >= n:
                 return b
         return self._admit_buckets[-1]
+
+    # ------------------------------------------------------ elastic banks
+    def _set_bank(self, S_to: int) -> None:
+        S_from = self.S
+        if S_to == S_from:
+            return
+        if S_to < S_from:
+            busy = [s for s in self.occupied if s >= S_to]
+            if busy:  # pragma: no cover — callers check first
+                raise RuntimeError(
+                    f"bank shrink {S_from}->{S_to} with occupied slots "
+                    f"{busy}"
+                )
+        t0 = time.perf_counter()
+        self._st = self._resize_fn(S_from, S_to)(self._st)
+        if S_to > S_from:
+            self.free.extend(range(S_from, S_to))
+        else:
+            self.free = [s for s in self.free if s < S_to]
+        self.free.sort()
+        self.S = S_to
+        self.resize_count += 1
+        self.last_resize_ms = (time.perf_counter() - t0) * 1e3
+        self.worst_resize_ms = max(
+            self.worst_resize_ms, self.last_resize_ms
+        )
+        _log.info(
+            "slot bank %d -> %d (%.2fms dispatch)",
+            S_from, S_to, self.last_resize_ms,
+        )
+
+    def maybe_resize(self, pending: int = 0) -> int:
+        """Elastic-bank policy, called by the scheduler at tick
+        boundaries with its queue depth.  Grows (possibly several rungs)
+        while pending work exceeds free slots; shrinks one rung after
+        ``slot_shrink_idle_ticks`` consecutive ticks in which the
+        occupancy + queue fits the next bank down.  Returns the
+        (possibly new) bank size.  All transitions are pre-jitted by
+        :meth:`warmup` — a resize is a ladder hit, never a retrace."""
+        if len(self.bank_ladder) == 1:
+            return self.S
+        i = self.bank_ladder.index(self.S)
+        grew = False
+        while (
+            pending > len(self.free)
+            and i + 1 < len(self.bank_ladder)
+        ):
+            i += 1
+            self._set_bank(self.bank_ladder[i])
+            grew = True
+        if grew:
+            self._shrink_streak = 0
+            return self.S
+        if i > 0:
+            lower = self.bank_ladder[i - 1]
+            fits = (
+                self.n_occupied + pending <= lower
+                and all(s < lower for s in self.occupied)
+            )
+            if fits:
+                self._shrink_streak += 1
+                if self._shrink_streak >= self.shrink_after:
+                    self._set_bank(lower)
+                    self._shrink_streak = 0
+            else:
+                self._shrink_streak = 0
+        return self.S
+
+    # ------------------------------------------------------ byte accounting
+    def state_bytes(self) -> int:
+        """Exact bytes of the resident decode-state pytree (allocated
+        bank), measured from the arrays themselves."""
+        return int(sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(self._st)
+        ))
+
+    def cache_bytes(self) -> int:
+        """Bytes of the stored (read-only) DecodeCache leaves — the
+        component the dedup collapses exactly K×."""
+        return int(sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree.leaves(self._st.cache)
+        ))
+
+    def carry_bytes(self) -> int:
+        """Bytes of the genuinely per-row carry (h/c, seqs, scores,
+        finished, tokens, counters) — unchanged by the dedup."""
+        return self.state_bytes() - self.cache_bytes()
+
+    def per_slot_bytes(self) -> int:
+        """Decode-state bytes per in-flight request.  Every leaf's
+        slot/row axis scales linearly with S, so this is exact integer
+        arithmetic, not an estimate."""
+        return self.state_bytes() // self.S
+
+    def live_state_bytes(self) -> int:
+        """Bytes attributable to OCCUPIED slots (freed rows are zeroed
+        at free time, so this is what is live, honestly)."""
+        return self.per_slot_bytes() * self.n_occupied
+
+    def expected_state_bytes(self, S: Optional[int] = None) -> int:
+        """Closed-form bytes-per-bank formula from config shapes — the
+        machine-checked twin of :meth:`state_bytes` (tier-1 asserts
+        they agree exactly, so a layout regression fails the build).
+
+        cache (per stored row): E·cdt  (ctx_static)
+                              + F·E·cdt (att_vals) + F·A·cdt (att_proj)
+                              + F·4    (att_mask, f32)
+                              + C·cdt  (cat_emb)
+          × S stored rows deduped, S·K replicated;
+        carry (per slot):  layers·K·H·(cdt+4)   (h compute-dtype, c f32)
+                         + K·L·4 (seqs) + K·4 (beam scores)
+                         + K (finished bool) + K·4 (tokens) + 4 (step).
+        """
+        S = self.S if S is None else S
+        m, d = self.model, self.engine.cfg.data
+        K, L = self.K, self.L
+        cdt = jnp.dtype(m.compute_dtype).itemsize
+        E, H = m.embed_size, m.rnn_size
+        F = d.max_frames * len(d.feature_modalities)
+        A = m.att_hidden_size if m.fusion == "attention" else 0
+        C = m.category_embed_size if m.use_category else 0
+        cache_row = E * cdt + F * E * cdt + F * A * cdt + F * 4 + C * cdt
+        cache = self._cache_rows(S) * cache_row
+        carry = S * (
+            m.num_layers * K * H * (cdt + 4)
+            + K * L * 4
+            + (0 if self.greedy else K * 4)
+            + K
+            + K * 4
+            + 4
+        )
+        return cache + carry
 
     # --------------------------------------------------------------- host
     @property
@@ -386,7 +731,9 @@ class SlotDecoder:
             # OOM) leaks nothing.
             reqs = list(prepared) + [prepared[-1]] * (A - n)
             rows = self.engine.encode_prepared_rows(reqs)
-            slots = [self.free.pop() for _ in range(n)]
+            # Lowest-index slots first: keeps occupancy packed toward
+            # the bank prefix so elastic shrinks stay possible.
+            slots = [self.free.pop(0) for _ in range(n)]
             for s in slots:
                 if s in self.occupied:  # pragma: no cover — invariant
                     raise RuntimeError(f"slot {s} double-assigned")
@@ -414,11 +761,12 @@ class SlotDecoder:
         occupant was admitted AFTER the handle's tick are excluded —
         their done flags in this handle describe the PREVIOUS occupant
         (double-buffered dispatch admits into freed slots before the
-        older tick is waited on)."""
+        older tick is waited on; the admit-tick check also keeps slot
+        indices within the handle's own bank shape across resizes)."""
         done_np = np.asarray(jax.device_get(handle.done))
         return [
             s for s in self.occupied
-            if bool(done_np[s]) and self.admit_tick[s] <= handle.seq
+            if self.admit_tick[s] <= handle.seq and bool(done_np[s])
         ]
 
     def tick(
@@ -450,8 +798,9 @@ class SlotDecoder:
     ) -> List[Tuple[Any, np.ndarray, float, int]]:
         """Extract done slots' best hypotheses from ``handle``'s tick
         outputs (no device call beyond fetching them once per handle)
-        and free the slots.  Returns ``[(data, tokens (L,) int32,
-        score, steps), ...]`` in ``slots`` order."""
+        and free the slots — zeroing their cache/carry rows so the
+        live-byte gauges stay honest.  Returns ``[(data, tokens (L,)
+        int32, score, steps), ...]`` in ``slots`` order."""
         if not slots:
             return []
         for s in slots:
@@ -489,13 +838,14 @@ class SlotDecoder:
             # its admission tick through the handle's tick ran `block`
             # steps over its rows.
             paid = (handle.seq - self.admit_tick.pop(slot) + 1) * self.block
-            self.free.append(slot)
+            bisect.insort(self.free, slot)
             out.append((
                 data,
                 seqs[i, best[i]],
                 float(final[i, best[i]]),
                 min(paid, self.L),
             ))
+        self._zero_slots(list(slots))
         return out
 
     def harvest(self, slot: int) -> Tuple[np.ndarray, float, int]:
@@ -508,7 +858,8 @@ class SlotDecoder:
         Returns the caller data so its future can be failed."""
         data = self.occupied.pop(slot)
         self.admit_tick.pop(slot, None)
-        self.free.append(slot)
+        bisect.insort(self.free, slot)
+        self._zero_slots([slot])
         return data
 
     def drain(self) -> List[Tuple[Any, np.ndarray, float, int]]:
@@ -521,24 +872,58 @@ class SlotDecoder:
         return out
 
     def warmup(self) -> None:
-        """Compile every tick variant (one per admission bucket, plus
-        the pure-step variant) so the first served request never pays
-        XLA compile latency."""
+        """Compile EVERY variant the loop can hit — tick fns per
+        admission bucket per bank (plus the pure-step variant), the
+        freed-slot blanking fn per bank, and both directions of every
+        bank transition — so neither the first served request nor a
+        bank regrow under traffic ever pays XLA compile latency."""
         req = self.engine.template_prepared()
-        for A in self._admit_buckets:
-            done = self.tick([req] * A, [None] * A)
-            self.harvest_many(done)
-            self.drain()
+        for bank in self.bank_ladder:
+            if bank != self.S:
+                self._set_bank(bank)          # compiles the grow fns
+            warm_As = sorted({
+                self._pad_bucket(min(b, bank))
+                for b in self._admit_buckets
+            })
+            for A in warm_As:
+                n = min(A, bank)
+                done = self.tick([req] * n, [None] * n)
+                self.harvest_many(done)
+                self.drain()
+            # The pure-step variant (A=0) may not be hit above when the
+            # template caption finishes within one block: compile it
+            # explicitly.  Empty slots are frozen, so stepping them is
+            # a no-op on every harvested number.
+            self._st, *_ = self._tick_fn(0)(
+                self.engine.params, self._st, None, None
+            )
+            if self.zero_freed:
+                self._free_fn(bank)(
+                    self._st, jnp.zeros((bank,), bool)
+                )
+        # Walk back down so the shrink transitions compile too, ending
+        # at the smallest bank (elastic capacity follows traffic up).
+        for bank in reversed(self.bank_ladder[:-1]):
+            self._set_bank(bank)
+        self.resize_count = 0
+        self.last_resize_ms = self.worst_resize_ms = 0.0
         assert not self.occupied and len(self.free) == self.S
 
     def describe(self) -> Dict[str, Any]:
         return {
             "slots": self.S,
+            "max_slots": self.S_max,
+            "bank_ladder": list(self.bank_ladder),
             "rows_per_slot": self.K,
             "block_steps": self.block,
             "max_steps": self.L,
             "mode": "greedy" if self.greedy else "beam",
             "admit_cap": self.admit_cap,
+            "dedup_cache": self.dedup,
+            "state_bytes": self.state_bytes(),
+            "live_state_bytes": self.live_state_bytes(),
+            "bytes_per_request": self.per_slot_bytes(),
+            "bank_resizes": self.resize_count,
         }
 
 
@@ -551,7 +936,10 @@ class _ParityEngine:
     drive the slot loop without the HTTP/batcher/cache stack.
     "Prepared requests" are plain video indices into the ctx batch."""
 
-    def __init__(self, ctx, *, mode: str, num_slots: int, block: int):
+    def __init__(
+        self, ctx, *, mode: str, num_slots: int, block: int,
+        dedup: bool = True, bank_min: int = 0,
+    ):
         from types import SimpleNamespace
 
         self.model = ctx.make_model()
@@ -565,7 +953,9 @@ class _ParityEngine:
         d0 = next(iter(ctx.feats.values()))
         self.cfg = SimpleNamespace(
             serving=SimpleNamespace(
-                num_slots=num_slots, slot_block_steps=block
+                num_slots=num_slots, slot_block_steps=block,
+                dedup_cache=dedup, slot_bank_min=bank_min,
+                slot_shrink_idle_ticks=4, zero_freed_slots=True,
             ),
             eval=SimpleNamespace(
                 beam_size=ctx.beam_size, max_decode_len=ctx.max_len,
@@ -594,13 +984,16 @@ class _ParityEngine:
         return 0
 
 
-def _slot_runner(ctx, mode: str):
+def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0):
     """Decode every ctx row through a small slot matrix with staggered
     admissions (slots hold rows at different decode depths), then map
-    harvests back to row order."""
+    harvests back to row order.  ``dedup`` selects the per-slot vs the
+    legacy replicated cache layout; ``bank_min`` > 0 exercises the
+    elastic bank ladder mid-traffic."""
     B = next(iter(ctx.feats.values())).shape[0]
     eng = _ParityEngine(
-        ctx, mode=mode, num_slots=max(2, B // 2), block=1
+        ctx, mode=mode, num_slots=max(2, B // 2), block=1,
+        dedup=dedup, bank_min=bank_min,
     )
     dec = SlotDecoder(eng)
     got_tok: Dict[int, np.ndarray] = {}
@@ -608,6 +1001,7 @@ def _slot_runner(ctx, mode: str):
     pending = list(range(B))
     stagger = 0
     while pending or dec.occupied:
+        dec.maybe_resize(len(pending))
         n = min(1 + stagger % 2, len(pending), len(dec.free),
                 dec.admit_cap)
         adm = [pending.pop(0) for _ in range(n)]
@@ -636,4 +1030,21 @@ register_backend(
     lambda ctx: _slot_runner(ctx, "greedy"),
     kind="greedy",
     ref="scan_greedy",
+)
+# The legacy replicated-cache layout stays registered so the deduped
+# default is pinned token-exact against it (and both against the scan
+# reference) through the one shared harness.
+register_backend(
+    "slot_decoder_beam_replicated",
+    lambda ctx: _slot_runner(ctx, "beam", dedup=False),
+    kind="beam",
+    ref="scan_beam",
+)
+# Elastic-bank variant: banks grow/shrink mid-traffic and tokens must
+# not move (prefix-copy resizes, row-independent steps).
+register_backend(
+    "slot_decoder_beam_elastic",
+    lambda ctx: _slot_runner(ctx, "beam", bank_min=2),
+    kind="beam",
+    ref="scan_beam",
 )

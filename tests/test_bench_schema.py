@@ -112,6 +112,38 @@ class TestBenchKind:
         rec["extra"]["cst_slot_host_cores"] = 8
         validate_record(rec)
 
+    def test_slot_mem_byte_fields_pass(self):
+        """The paired replicated-vs-deduped decode-state rows (ISSUE 7)
+        carry exact pytree byte accounting: numeric values validate."""
+        rec = good_bench()
+        rec["extra"].update(
+            slot_mem_dedup_state_bytes=129528,
+            slot_mem_replicated_state_bytes=335864,
+            slot_mem_dedup_bytes_per_request=16191,
+            slot_mem_formula_delta_bytes=0,
+            slot_mem_bytes_per_request_ratio=2.59,
+            slot_mem_regrow_count=4,
+            slot_mem_regrow_worst_ms=0.2,
+            slot_mem_host_cores=1,
+        )
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, False, None, "129528"])
+    def test_non_numeric_bytes_field_fails(self, bad):
+        """*_bytes fields are exact measurements by contract: a bool
+        (subclasses int!), None, or string means nothing was measured
+        and must fail at the emit site."""
+        rec = good_bench()
+        rec["extra"]["slot_mem_dedup_state_bytes"] = bad
+        with pytest.raises(ValueError, match="byte count|bool-typed"):
+            validate_record(rec)
+
+    def test_bool_bytes_ratio_fails(self):
+        rec = good_bench()
+        rec["extra"]["slot_mem_bytes_per_request_ratio"] = True
+        with pytest.raises(ValueError, match="bool-typed"):
+            validate_record(rec)
+
     def test_non_dict_extra_fails(self):
         rec = good_bench()
         rec["extra"] = [1, 2]

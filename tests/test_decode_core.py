@@ -94,10 +94,13 @@ class TestSharedParity:
 
     def test_all_five_consumers_registered(self):
         """The acceptance bar names five decode consumers; all must sit
-        behind the one registry."""
+        behind the one registry — plus the PR-7 slot-layout variants
+        (deduped default, legacy replicated cache, elastic banks), so
+        the memory layout is pinned through the same harness."""
         assert {
             "scan_beam", "fused_beam", "fused_sampler",
             "slot_decoder_beam", "slot_decoder_greedy",
+            "slot_decoder_beam_replicated", "slot_decoder_beam_elastic",
             "padded_rollout", "slot_rollout",
         } <= set(ALL_BACKENDS)
 
@@ -230,6 +233,16 @@ _FINGERPRINTS = [
     (re.compile(r"==\s*PAD_ID\s*,\s*EOS_ID"),
      {"decoding/core.py", "ops/pallas_beam.py", "ops/pallas_sampler.py",
       "training/cst.py"}),  # cst: the PG update's input shift, not a loop
+    # Cache replication at admission (PR 7): the deduped slot layout
+    # stores ONE DecodeCache row per slot — a new `jnp.repeat` fan-out
+    # of cached state is exactly the K x memory regression the dedup
+    # removed.  Allowed: the offline beam expansion (beam.py), the
+    # seq_per_img rollout fan-out (captioner.py), the fused kernels'
+    # twins, the CST reward broadcast (cst.py), and slots.py's
+    # flag-gated legacy replicated layout (serving.dedup_cache=false).
+    (re.compile(r"jnp\s*\.\s*repeat\s*\("),
+     {"decoding/beam.py", "models/captioner.py", "ops/pallas_beam.py",
+      "training/cst.py", "serving/slots.py"}),
 ]
 
 

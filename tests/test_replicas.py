@@ -94,10 +94,17 @@ class _StubDecoder:
         self._admit_seq = {}
         self._seq = 0
         self.fail_next = False    # poison pill: next tick_begin raises
+        self.resize_count = 0
 
     @property
     def n_occupied(self):
         return len(self.occupied)
+
+    def maybe_resize(self, pending=0):
+        return self.S
+
+    def live_state_bytes(self):
+        return 64 * self.n_occupied
 
     def tick_begin(self, prepared=(), datas=()):
         if self.fail_next:
@@ -317,6 +324,33 @@ class TestReplicaScheduler:
 
 # ---------------------------- cross-replica parity (real jax, 8 devices)
 
+class TestReplicaMemoryMetrics:
+    def test_per_replica_decode_state_gauges_render(self):
+        """ISSUE-7 satellite: the decode-state byte and slot-bank-size
+        gauges exist per replica, matching the PR-4 label scheme."""
+        from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+        m = ServingMetrics()
+        m.replica(0).decode_state_bytes.set(4096)
+        m.replica(0).slot_bank_size.set(8)
+        m.replica(1).decode_state_bytes.set(0)
+        m.decode_state_bytes.set(4096)
+        m.slot_bank_size.set(8)
+        m.slot_bank_resizes.inc(2)
+        text = m.to_prometheus()
+        assert 'caption_replica_decode_state_bytes{replica="0"} 4096' in text
+        assert 'caption_replica_slot_bank_size{replica="0"} 8' in text
+        assert 'caption_replica_decode_state_bytes{replica="1"} 0' in text
+        assert "caption_decode_state_bytes 4096" in text
+        assert "caption_slot_bank_size 8" in text
+        assert "caption_slot_bank_resizes_total 2" in text
+        d = m.to_dict()
+        assert d["slots"]["decode_state_bytes"] == 4096.0
+        assert d["slots"]["bank_size"] == 8.0
+        assert d["replicas"]["0"]["decode_state_bytes"] == 4096.0
+        assert d["replicas"]["0"]["slot_bank_size"] == 8.0
+
+
 @pytest.fixture(scope="module")
 def replica_world():
     """Source engine + offline beam predictions + two device-pinned
@@ -462,6 +496,40 @@ class TestCrossReplicaParity:
         assert not rs.replicas[0].decoder.occupied
         assert sorted(rs.replicas[0].decoder.free) == list(
             range(rs.replicas[0].decoder.S)
+        )
+
+    def test_cross_replica_cache_hit_admits_with_zero_encode(
+        self, replica_world
+    ):
+        """ISSUE-7: tier-2 encoder rows are shared across replicas
+        under one ``params_tag`` — after replica 0 encodes a
+        ``feature_id`` request, replica 1 admits the same id with ZERO
+        encoder recompute, and the hit-admitted slot decode still
+        produces the exact offline caption."""
+        from cst_captioning_tpu.data.vocab import decode_sequence
+
+        engine, clones, ds, offline, payloads = replica_world
+        c0, c1 = clones
+        body = dict(payloads[3])
+        body["feature_id"] = "xrep3"
+        req = c0.prepare(body)
+        e0 = c0.admit_rows_encoded
+        c0.encode_prepared_rows([req])      # miss: pays the encode once
+        assert c0.admit_rows_encoded == e0 + 1
+        req1 = c1.prepare({"feature_id": "xrep3"})
+        assert req1.enc_row is not None     # shared tier-2 hit
+        hits0, enc0 = c1.admit_rows_cached, c1.admit_rows_encoded
+        c1.encode_prepared_rows([req1])
+        assert c1.admit_rows_encoded == enc0    # zero recompute
+        assert c1.admit_rows_cached == hits0 + 1
+        dec = c1.slot_decoder()
+        done = dec.tick([req1], ["x"])
+        while not done:
+            done = dec.tick()
+        _, tokens, _, _ = dec.harvest_many(done)[0]
+        assert (
+            decode_sequence(c1.vocab, tokens[None])[0]
+            == offline[ds.video_id(3)]
         )
 
 

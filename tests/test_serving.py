@@ -387,10 +387,17 @@ class _StubSlotDecoder:
         self.occupied = {}
         self.steps_paid = {}
         self._remaining = {}
+        self.resize_count = 0
 
     @property
     def n_occupied(self):
         return len(self.occupied)
+
+    def maybe_resize(self, pending=0):
+        return self.S
+
+    def live_state_bytes(self):
+        return 64 * self.n_occupied
 
     def tick(self, prepared=(), datas=()):
         for req, data in zip(prepared, datas):
@@ -757,6 +764,274 @@ class TestContinuousGreedyParity:
         decoder = geng.slot_decoder()
         assert not decoder.occupied
         assert sorted(decoder.free) == list(range(decoder.S))
+
+
+# ----------------------- decode-state memory (dedup + elastic, ISSUE 7)
+
+@pytest.fixture(scope="module")
+def mem_world():
+    """Two engines over the SAME params — deduped (default) and legacy
+    replicated decode-state layouts — on a cache-dominant shape (more
+    frames than the smoke preset, the MSR-VTT regime where the
+    projected cache is most of a slot's bytes)."""
+    from cst_captioning_tpu.data.build import build_dataset
+    from cst_captioning_tpu.evaluation import beam_decode_dataset
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.data.max_frames = 20
+    cfg.serving.num_slots = 4
+    cfg.serving.slot_block_steps = 1
+    ds, vocab = build_dataset(cfg, cfg.eval.eval_split)
+    cfg.model.vocab_size = len(vocab)
+    dd = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    rr = InferenceEngine(
+        cfg.replace(**{"serving.dedup_cache": False}),
+        params=dd.params, vocab=vocab,
+    )
+    offline = beam_decode_dataset(dd.model, dd.params, ds, cfg)
+    payloads = [
+        {
+            "features": {m: a.tolist() for m, a in ds.features(i).items()},
+            "feature_id": f"mem{i}",
+        }
+        for i in range(8)
+    ]
+    return dd, rr, ds, offline, payloads
+
+
+def _drive_staggered(engine, reqs, datas):
+    """Decode ``reqs`` through the engine's slot decoder with staggered
+    admissions; returns {data: (tokens, steps)}."""
+    dec = engine.slot_decoder()
+    got = {}
+    pending = list(zip(reqs, datas))
+    stagger = 0
+    while pending or dec.occupied:
+        dec.maybe_resize(len(pending))
+        n = min(1 + stagger % 2, len(pending), len(dec.free),
+                dec.admit_cap)
+        batch = [pending.pop(0) for _ in range(n)]
+        stagger += 1
+        done = dec.tick([r for r, _ in batch], [d for _, d in batch])
+        for d, tokens, score, steps in dec.harvest_many(done):
+            got[d] = (tokens, steps)
+    return got
+
+
+class TestDecodeStateMemory:
+    def test_state_bytes_formula_machine_checked(self, mem_world):
+        """THE memory bar: measured pytree bytes equal the closed-form
+        shape formula EXACTLY for both layouts; the dedup collapses the
+        cache component exactly K x, leaves the carry untouched, and
+        cuts bytes per in-flight request >= 0.8*K x on a cache-dominant
+        shape.  A layout regression (an accidental re-replication, a
+        new per-row leaf) fails tier-1 here."""
+        dd, rr, *_ = mem_world
+        dec_d, dec_r = dd.slot_decoder(), rr.slot_decoder()
+        K = dec_d.K
+        assert K > 1  # beam mode, or the dedup is vacuous
+        for dec in (dec_d, dec_r):
+            assert dec.state_bytes() == dec.expected_state_bytes()
+        assert dec_r.cache_bytes() == K * dec_d.cache_bytes()
+        assert dec_r.carry_bytes() == dec_d.carry_bytes()
+        ratio = dec_r.per_slot_bytes() / dec_d.per_slot_bytes()
+        assert ratio >= 0.8 * K, (
+            f"per-request bytes dropped only {ratio:.2f}x "
+            f"(bar: {0.8 * K:.1f}x for K={K})"
+        )
+
+    def test_layouts_serve_identical_captions_matching_offline(
+        self, mem_world
+    ):
+        """Both layouts, same staggered admission schedule: tokens are
+        identical to each other AND to the offline eval decode — the
+        shared-copy read cannot change any caption."""
+        from cst_captioning_tpu.data.vocab import decode_sequence
+
+        dd, rr, ds, offline, payloads = mem_world
+        for eng in (dd, rr):
+            reqs = [eng.prepare(dict(p)) for p in payloads]
+            got = _drive_staggered(eng, reqs, list(range(len(reqs))))
+            assert sorted(got) == list(range(len(payloads)))
+            for i, (tokens, steps) in got.items():
+                caption = decode_sequence(eng.vocab, tokens[None])[0]
+                assert caption == offline[ds.video_id(i)], (
+                    f"video {i} diverged under "
+                    f"{'dedup' if eng is dd else 'replicated'} layout"
+                )
+                assert 0 < steps <= eng.slot_decoder().L
+
+    def test_freed_slots_zero_rows_and_live_bytes_are_honest(
+        self, mem_world
+    ):
+        """Zero-on-free: while slots are occupied the live-byte gauge
+        is per-slot bytes x occupancy; at free time the slots' cache
+        AND carry rows are blanked to the empty pattern.  (Freed CACHE
+        rows stay zero forever — they are read-only; freed h/c rows
+        are step scratch the next tick recomputes for the whole
+        matrix, so they are asserted right after the freeing harvest,
+        before any further tick.)"""
+        import jax
+
+        from cst_captioning_tpu.constants import PAD_ID
+
+        dd, _, ds, offline, payloads = mem_world
+        dec = dd.slot_decoder()
+        reqs = [dd.prepare(dict(p)) for p in payloads[:3]]
+        done = dec.tick(reqs, [0, 1, 2])
+        assert dec.n_occupied == 3
+        assert dec.live_state_bytes() == 3 * dec.per_slot_bytes()
+        # Step (without harvesting) until all three finish, then free
+        # them in ONE harvest so no later tick re-steps the zeroed rows.
+        while len(done) < 3:
+            done = dec.tick()
+        dec.harvest_many(done)
+        assert dec.n_occupied == 0
+        assert dec.live_state_bytes() == 0
+        for leaf in jax.tree.leaves(dec._st.cache):
+            assert (np.asarray(leaf) == 0).all(), "stale cache rows"
+        nK = 3 * dec.K                    # rows of the 3 freed slots
+        assert (np.asarray(dec._st.core.state.h)[:, :nK] == 0).all()
+        assert (np.asarray(dec._st.core.state.c)[:, :nK] == 0).all()
+        assert (np.asarray(dec._st.core.seqs) == PAD_ID).all()
+        assert bool(np.asarray(dec._st.core.finished).all())
+
+    def test_cache_hit_admission_skips_encoder(self, mem_world):
+        """Tier-2 zero-recompute admission: rows that carry cached
+        encoder state never touch ``init_decode`` — pure hits encode
+        nothing, mixed batches encode ONLY the misses — and the mixed
+        batch still serves offline-exact captions."""
+        from cst_captioning_tpu.data.vocab import decode_sequence
+
+        dd, _, ds, offline, payloads = mem_world
+        # The parity test above stored tier-2 rows for these ids.
+        hits = [dd.prepare({"feature_id": f"mem{i}"}) for i in (0, 1)]
+        assert all(r.enc_row is not None for r in hits)
+        e0 = dd.admit_rows_encoded
+        dd.encode_prepared_rows(hits)
+        assert dd.admit_rows_encoded == e0  # zero encoder recompute
+        assert dd.admit_rows_cached >= 2
+        # Mixed batch: a hit plus a never-seen request.
+        fresh = dd.prepare({
+            "features": payloads[2]["features"], "feature_id": None,
+        })
+        fresh = fresh._replace(enc_row=None)
+        e0 = dd.admit_rows_encoded
+        got = _drive_staggered(dd, [hits[0], fresh], ["hit", "miss"])
+        assert dd.admit_rows_encoded - e0 >= 1  # the miss paid
+        caption = decode_sequence(dd.vocab, got["hit"][0][None])[0]
+        assert caption == offline[ds.video_id(0)]
+        caption = decode_sequence(dd.vocab, got["miss"][0][None])[0]
+        assert caption == offline[ds.video_id(2)]
+
+
+class TestElasticSlotBanks:
+    @pytest.fixture(scope="class")
+    def elastic_world(self, mem_world):
+        """An elastic-bank engine (ladder 2 -> 4 -> 8) over mem_world's
+        params, fully warmed so every tick variant and transition is
+        compiled."""
+        from cst_captioning_tpu.serving.engine import InferenceEngine
+
+        dd, _, ds, offline, payloads = mem_world
+        cfg = dd.cfg.replace(**{
+            "serving.num_slots": 8,
+            "serving.max_batch_size": 8,
+            "serving.batch_shapes": [],
+            "serving.slot_bank_min": 2,
+            "serving.slot_shrink_idle_ticks": 3,
+        })
+        eng = InferenceEngine(cfg, params=dd.params, vocab=dd.vocab)
+        dec = eng.slot_decoder()
+        dec.warmup()
+        return eng, dec, ds, offline, payloads
+
+    def test_warmup_ends_small_and_ladder_is_complete(
+        self, elastic_world
+    ):
+        eng, dec, *_ = elastic_world
+        assert dec.bank_ladder == [2, 4, 8]
+        assert dec.S == 2                      # capacity follows traffic
+        assert sorted(dec.free) == list(range(dec.S))
+        d = dec.describe()
+        assert d["bank_ladder"] == [2, 4, 8]
+        assert d["dedup_cache"] is True
+
+    def test_regrow_at_tick_boundary_is_prejitted_ladder_hit(
+        self, elastic_world
+    ):
+        """THE no-cold-retrace pin: after warmup, growing under queue
+        pressure and shrinking when idle — with real traffic decoded at
+        every bank — builds ZERO new compiled variants, and the bank
+        follows pressure both ways."""
+        eng, dec, ds, offline, payloads = elastic_world
+        compiles = dec.compile_count
+        reqs = [eng.prepare(dict(p)) for p in payloads]
+        got = _drive_staggered(eng, reqs, list(range(len(reqs))))
+        assert len(got) == len(payloads)
+        # Pressure beyond the current bank grows it (several rungs).
+        dec.maybe_resize(pending=7)
+        assert dec.S == 8
+        assert sorted(dec.free) == list(range(8))
+        # Idle ticks walk it back down one rung per streak.
+        for _ in range(dec.shrink_after * 4):
+            dec.maybe_resize(0)
+        assert dec.S == 2
+        assert dec.resize_count >= 3
+        assert dec.compile_count == compiles, (
+            "bank transition retraced — the ladder must be fully "
+            "compiled at warmup"
+        )
+
+    def test_fuzzed_admit_evict_regrow_no_double_assign(
+        self, elastic_world
+    ):
+        """Randomized admission / eviction / resize sequences across
+        bank transitions: the free list and occupancy stay an exact
+        partition of the current bank, nothing double-assigns (the
+        decoder hard-raises), and the world drains clean."""
+        eng, dec, ds, offline, payloads = elastic_world
+        rng = np.random.RandomState(5)
+        reqs = [eng.prepare(dict(p)) for p in payloads]
+        grew = shrank = 0
+        serial = 0
+        for it in range(60):
+            # Burst, then sustained load, then quiet: decodes ride ~L
+            # ticks, so occupancy climbs through the burst phase (grow)
+            # and drains in the quiet tail (shrink) within one run.
+            busy = it < 25
+            pending = 8 if it == 0 else (
+                int(rng.randint(0, 3)) if busy else 0
+            )
+            s0 = dec.S
+            dec.maybe_resize(pending)
+            grew += dec.S > s0
+            shrank += dec.S < s0
+            n = min(
+                int(rng.randint(0, 3)) if busy else 0,
+                len(dec.free), dec.admit_cap,
+            )
+            adm = [reqs[int(rng.randint(0, len(reqs)))] for _ in range(n)]
+            done = dec.tick(adm, [f"r{serial + j}" for j in range(n)])
+            serial += n
+            if done and rng.rand() < 0.3:
+                dec.evict(done[0])
+                done = done[1:]
+            dec.harvest_many(done)
+            occ = set(dec.occupied)
+            free = set(dec.free)
+            assert not (occ & free)
+            assert occ | free == set(range(dec.S)), (
+                it, sorted(occ), sorted(free), dec.S
+            )
+        dec.drain()
+        for _ in range(dec.shrink_after * 4):
+            dec.maybe_resize(0)
+        assert grew >= 1 and shrank >= 1
+        assert not dec.occupied
+        assert sorted(dec.free) == list(range(dec.S))
 
 
 class TestBeamEarlyExit:
