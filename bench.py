@@ -1999,6 +1999,29 @@ def main() -> int:
     errors = {}
     state = {"sps_chip": None}
 
+    # PR 8: invariant-engine preflight.  The pure-AST pass costs ~2s, so
+    # a bench run never measures a tree that violates the machine-checked
+    # contracts (docs/ANALYSIS.md) without the record SAYING so — the
+    # measurements still run (numbers from a dirty tree beat no numbers),
+    # but ``errors.analysis`` marks them.  BENCH_SKIP_ANALYSIS=1 bypasses.
+    if not int(os.environ.get("BENCH_SKIP_ANALYSIS", "0") or 0):
+        try:
+            from cst_captioning_tpu.analysis import (
+                run_analysis,
+                validate_report,
+            )
+
+            _rep = run_analysis()
+            validate_report(_rep.to_dict())
+            extra["analysis_findings"] = len(_rep.findings)
+            extra["analysis_duration_s"] = round(_rep.duration_s, 3)
+            if not _rep.clean:
+                errors["analysis"] = "; ".join(
+                    f.render() for f in _rep.findings[:5]
+                )
+        except Exception as e:  # noqa: BLE001 — preflight never sinks bench
+            errors["analysis"] = f"{type(e).__name__}: {e}"
+
     def emit(partial: bool = True):
         """Print the record as it stands — ONE line per completed
         sub-bench (VERDICT r5 #2): a ~3-minute backend window

@@ -1,0 +1,23 @@
+"""Invariant engine: AST-based machine-checking of the repo's
+correctness contracts (rule catalogue in docs/ANALYSIS.md).
+
+Public surface::
+
+    from cst_captioning_tpu.analysis import run_analysis
+    report = run_analysis()          # whole package, all rules
+    report.findings                  # unsuppressed [Finding]
+
+    python -m cst_captioning_tpu.analysis [--json]   # CLI / preflight
+
+The engine is pure stdlib-AST (no jax import) so it runs in well under
+the 30 s tier-1 budget; the dynamic lock-order twin lives in
+``analysis.lockwatch`` and runs under stub traffic in tier-1.
+"""
+
+from cst_captioning_tpu.analysis.engine import (  # noqa: F401
+    CHECKERS,
+    Finding,
+    Report,
+    run_analysis,
+    validate_report,
+)

@@ -1,0 +1,65 @@
+"""CLI for the invariant engine — pre-commit / bench preflight entry.
+
+    python -m cst_captioning_tpu.analysis            # human output
+    python -m cst_captioning_tpu.analysis --json     # machine-readable
+    python -m cst_captioning_tpu.analysis --rules single_site,donation
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 over the wall-clock
+budget (``ANALYSIS_BUDGET_S``, default 30 — the same discipline as
+``TIER1_BUDGET_S``: a slow pass silently eats the suite's headroom).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from pathlib import Path
+
+from cst_captioning_tpu.analysis.engine import run_analysis, validate_report
+
+DEFAULT_BUDGET_S = 30.0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m cst_captioning_tpu.analysis",
+        description="Run the invariant engine over the package.",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="emit the machine-readable report on stdout",
+    )
+    ap.add_argument(
+        "--rules", default="",
+        help="comma-separated rule families (default: all)",
+    )
+    ap.add_argument(
+        "--root", default="",
+        help="package root to scan (default: the installed package)",
+    )
+    args = ap.parse_args(argv)
+
+    budget = float(os.environ.get("ANALYSIS_BUDGET_S", DEFAULT_BUDGET_S))
+    report = run_analysis(
+        Path(args.root) if args.root else None,
+        rules=[r for r in args.rules.split(",") if r] or None,
+    )
+    if args.json:
+        rec = validate_report(report.to_dict())
+        print(json.dumps(rec, indent=2))
+    else:
+        print(report.render())
+    if budget and report.duration_s > budget:
+        print(
+            f"ANALYSIS BUDGET EXCEEDED: {report.duration_s:.1f}s > "
+            f"ANALYSIS_BUDGET_S={budget:.0f}s",
+            file=sys.stderr,
+        )
+        return 2
+    return 0 if report.clean else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Shared AST plumbing for the invariant engine: the module index
+(parse the package once), dotted-name resolution, enclosing-scope
+qualnames, and the intra-package call graph the checkers walk.
+
+Pure stdlib-``ast`` on purpose: the analysis pass runs as a pre-commit /
+bench preflight and inside tier-1, so it must not import jax (or the
+package under analysis) — it READS source, it never executes it.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Tuple
+
+PACKAGE = "cst_captioning_tpu"
+
+
+def dotted(node: ast.AST) -> str:
+    """``a.b.c`` for a Name/Attribute chain, ``""`` for anything else."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call's callee (``""`` for computed callees)."""
+    return dotted(node.func)
+
+
+@dataclass
+class FuncInfo:
+    """One function/method definition (incl. nested defs and lambdas)."""
+
+    module: "ModuleInfo"
+    qualname: str                  # e.g. "ReplicaSet._worker_loop"
+    node: ast.AST                  # FunctionDef | AsyncFunctionDef | Lambda
+    cls: Optional[str] = None      # enclosing class name, if a method
+
+    @property
+    def name(self) -> str:
+        return self.qualname.rsplit(".", 1)[-1]
+
+    @property
+    def params(self) -> List[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file of the package under analysis."""
+
+    path: Path
+    rel: str                       # package-relative posix path
+    tree: ast.Module
+    source: str
+    # name in this module -> fully dotted target it was imported from
+    # ("cst_captioning_tpu.decoding.core.decode_step" for symbols,
+    #  "cst_captioning_tpu.decoding.core" for module aliases).
+    imports: Dict[str, str] = field(default_factory=dict)
+    functions: Dict[str, FuncInfo] = field(default_factory=dict)
+    classes: Dict[str, ast.ClassDef] = field(default_factory=dict)
+    parent: Dict[ast.AST, ast.AST] = field(default_factory=dict)
+
+    @property
+    def modname(self) -> str:
+        stem = self.rel[:-3].replace("/", ".")
+        if stem.endswith(".__init__"):
+            stem = stem[: -len(".__init__")]
+        return f"{PACKAGE}.{stem}" if stem else PACKAGE
+
+    def qualname_of(self, node: ast.AST) -> str:
+        """Dotted path of enclosing defs/classes for any node
+        ("<module>" at top level)."""
+        parts: List[str] = []
+        cur = self.parent.get(node)
+        while cur is not None:
+            if isinstance(
+                cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+            ):
+                parts.append(cur.name)
+            cur = self.parent.get(cur)
+        return ".".join(reversed(parts)) or "<module>"
+
+
+def _index_module(mi: ModuleInfo) -> None:
+    for node in ast.walk(mi.tree):
+        for child in ast.iter_child_nodes(node):
+            mi.parent[child] = node
+
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.Import):
+            for al in node.names:
+                mi.imports[al.asname or al.name.split(".")[0]] = al.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            base = node.module
+            if node.level:  # relative import -> resolve against package
+                pkg_parts = mi.modname.split(".")[: -node.level]
+                base = ".".join(pkg_parts + ([node.module] if node.module else []))
+            for al in node.names:
+                mi.imports[al.asname or al.name] = f"{base}.{al.name}"
+
+    class _V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.stack: List[str] = []
+            self.lambda_seq = 0
+
+        def _add(self, node, name: str, cls: Optional[str]) -> None:
+            qn = ".".join(self.stack + [name])
+            mi.functions[qn] = FuncInfo(mi, qn, node, cls=cls)
+
+        def visit_ClassDef(self, node: ast.ClassDef) -> None:
+            mi.classes[node.name] = node
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            cls = self.stack[-1] if (
+                self.stack and self.stack[-1] in mi.classes
+            ) else None
+            self._add(node, node.name, cls)
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef  # type: ignore[assignment]
+
+        def visit_Lambda(self, node: ast.Lambda) -> None:
+            self.lambda_seq += 1
+            self._add(node, f"<lambda#{self.lambda_seq}>", None)
+            self.generic_visit(node)
+
+    _V().visit(mi.tree)
+
+
+def scan_package(root: Path) -> List[ModuleInfo]:
+    """Parse every ``.py`` under ``root`` once, sorted by relative path.
+    ``root`` is the package directory (the one holding ``__init__.py``)
+    or any directory of loose files (the seeded-violation corpus)."""
+    mods: List[ModuleInfo] = []
+    for path in sorted(root.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        rel = path.relative_to(root).as_posix()
+        src = path.read_text()
+        try:
+            tree = ast.parse(src, filename=str(path))
+        except SyntaxError as e:  # corpus files must still be valid py
+            raise SyntaxError(f"{rel}: {e}") from e
+        mi = ModuleInfo(path=path, rel=rel, tree=tree, source=src)
+        _index_module(mi)
+        mods.append(mi)
+    return mods
+
+
+class PackageIndex:
+    """Cross-module symbol table + call-graph resolution."""
+
+    def __init__(self, modules: List[ModuleInfo]):
+        self.modules = modules
+        self.by_rel: Dict[str, ModuleInfo] = {m.rel: m for m in modules}
+        self.by_modname: Dict[str, ModuleInfo] = {
+            m.modname: m for m in modules
+        }
+        # (modname, top-level-or-method qualname) -> FuncInfo
+        self.funcs: Dict[Tuple[str, str], FuncInfo] = {}
+        for m in modules:
+            for qn, fi in m.functions.items():
+                self.funcs[(m.modname, qn)] = fi
+        # method name -> [FuncInfo] across all classes (fallback lookup)
+        self.methods_by_name: Dict[str, List[FuncInfo]] = {}
+        for (_, qn), fi in self.funcs.items():
+            if fi.cls is not None:
+                self.methods_by_name.setdefault(fi.name, []).append(fi)
+
+    def resolve_call(
+        self, mi: ModuleInfo, caller: FuncInfo, node: ast.Call
+    ) -> List[FuncInfo]:
+        """Best-effort resolution of a call to package functions.
+
+        Handles: local names, ``from pkg.x import f`` names, module
+        aliases (``core.decode_step``), ``self.method`` within a class,
+        and flax ``X.apply(..., method="name")`` indirection (resolved
+        to every package method of that name — the model hook pattern).
+        Unresolvable callees return [].
+        """
+        name = call_name(node)
+        out: List[FuncInfo] = []
+        if not name:
+            return out
+
+        # flax apply indirection: X.apply(params, ..., method="m")
+        if name.endswith(".apply"):
+            target = "__call__"
+            for kw in node.keywords:
+                if kw.arg == "method" and isinstance(kw.value, ast.Constant):
+                    target = str(kw.value.value)
+            return list(self.methods_by_name.get(target, []))
+
+        head, _, rest = name.partition(".")
+        if head == "self" and caller.cls is not None:
+            if rest and "." not in rest:
+                fi = mi.functions.get(f"{caller.cls}.{rest}")
+                return [fi] if fi else []
+            return out
+        if not rest:
+            # plain name: sibling def in the same scope chain, then
+            # module level, then imports
+            scope = caller.qualname.rsplit(".", 1)[0]
+            for qn in (f"{scope}.{head}", head):
+                fi = mi.functions.get(qn)
+                if fi:
+                    return [fi]
+            imp = mi.imports.get(head)
+            if imp and imp.startswith(PACKAGE):
+                modname, _, sym = imp.rpartition(".")
+                m2 = self.by_modname.get(modname)
+                if m2 and sym in m2.functions:
+                    return [m2.functions[sym]]
+            return out
+        # dotted: module alias (core.decode_step) or class attr
+        imp = mi.imports.get(head)
+        if imp and imp.startswith(PACKAGE):
+            m2 = self.by_modname.get(imp)
+            if m2 and rest in m2.functions:
+                return [m2.functions[rest]]
+        return out
+
+
+def walk_body(fn: FuncInfo, *, into_nested: bool = False):
+    """Walk a function's own body; by default stop at nested def/lambda
+    boundaries (nested defs are their own FuncInfo — decorators and
+    default expressions of a nested def still belong to the parent and
+    are walked)."""
+    stack = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        if not into_nested and isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            # still surface the nested def's decorators/defaults
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                stack.extend(node.decorator_list)
+                stack.extend(
+                    d
+                    for d in node.args.defaults + node.args.kw_defaults
+                    if d is not None
+                )
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def func_body_calls(fn: FuncInfo) -> Iterable[ast.Call]:
+    """Every Call in a function's own body (nested defs excluded)."""
+    for node in walk_body(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+

@@ -1,0 +1,156 @@
+"""CST-DON: donation + compile-discipline lint over jit call sites.
+
+Two contracts from the perf PRs:
+
+* **Donation** (PR 5, docs/PARITY.md r9): update-step jit sites donate
+  the incoming TrainState (``donate_argnums=(0,)``) so param/optimizer
+  buffers are aliased in place — pinned against the lowered StableHLO
+  by tests/test_training.py::TestBufferDonation.  A NEW update step
+  that forgets donation doubles peak memory silently; CST-DON-001
+  catches it at the AST.
+* **Compile discipline** (PR 2/3/7): every jit call site must have a
+  KNOWN retrace story (a fixed shape ladder, a pre-warmed bank ladder,
+  a handful of static values) — the ``compile_count`` pinning in
+  serving and the bench exit heuristics depend on it.  CST-DON-002
+  requires every jit site in the package to be registered in
+  ``jit_registry.py`` with an expected retrace budget; CST-DON-003
+  flags stale registry entries so the registry cannot rot.
+
+Site keys are ``<file>::<qualname>`` (decorated defs) or
+``<file>::<enclosing qualname>::<target>`` (jit-by-call) — stable under
+reformatting, unlike line numbers.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from cst_captioning_tpu.analysis.astutil import (
+    ModuleInfo,
+    call_name,
+    dotted,
+)
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+from cst_captioning_tpu.analysis import jit_registry
+
+_JIT_CALLEES = {"jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit"}
+_PARTIAL = {"functools.partial", "partial"}
+
+
+def _is_jit_call(node: ast.Call) -> bool:
+    return call_name(node) in _JIT_CALLEES
+
+
+def _is_jit_partial(node: ast.Call) -> bool:
+    return (
+        call_name(node) in _PARTIAL
+        and bool(node.args)
+        and dotted(node.args[0]) in _JIT_CALLEES
+    )
+
+
+def _has_donate(node: ast.Call) -> bool:
+    return any(
+        kw.arg in ("donate_argnums", "donate_argnames")
+        for kw in node.keywords
+    )
+
+
+def collect_jit_sites(
+    modules: List[ModuleInfo],
+) -> List[Tuple[str, ModuleInfo, ast.Call, str]]:
+    """Every jit application in the package as
+    ``(site_key, module, kwargs-carrying Call, symbol)``."""
+    sites: List[Tuple[str, ModuleInfo, ast.Call, str]] = []
+    seen: Dict[str, int] = {}
+
+    def add(key: str, mi: ModuleInfo, call: ast.Call, sym: str) -> None:
+        # Deterministic dedupe of key collisions (two jit lambdas in
+        # one scope): suffix #2, #3 ... in line order.
+        n = seen.get(key, 0) + 1
+        seen[key] = n
+        if n > 1:
+            key = f"{key}#{n}"
+        sites.append((key, mi, call, sym))
+
+    for mi in modules:
+        decorated_calls: Set[int] = set()
+        for qn, fn in sorted(
+            mi.functions.items(), key=lambda kv: kv[1].line
+        ):
+            node = fn.node
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jit_call(dec) or _is_jit_partial(dec)
+                ):
+                    decorated_calls.add(id(dec))
+                    add(f"{mi.rel}::{qn}", mi, dec, qn)
+                elif dotted(dec) in _JIT_CALLEES:
+                    # bare @jax.jit — synthesize an argless marker call
+                    marker = ast.Call(func=dec, args=[], keywords=[])
+                    ast.copy_location(marker, dec)
+                    add(f"{mi.rel}::{qn}", mi, marker, qn)
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call) or id(node) in decorated_calls:
+                continue
+            if _is_jit_call(node) and node.args:
+                target = node.args[0]
+                tname = (
+                    target.id if isinstance(target, ast.Name)
+                    else "<lambda>" if isinstance(target, ast.Lambda)
+                    else dotted(target) or "<expr>"
+                )
+                scope = mi.qualname_of(node)
+                add(
+                    f"{mi.rel}::{scope}::{tname}", mi, node,
+                    f"{scope}::{tname}",
+                )
+    return sites
+
+
+@register_checker("donation")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    out: List[Finding] = []
+    sites = collect_jit_sites(modules)
+    seen_keys = set()
+    for key, mi, call, sym in sites:
+        seen_keys.add(key)
+        entry = jit_registry.JIT_SITE_REGISTRY.get(key)
+        if entry is None:
+            out.append(Finding(
+                "CST-DON-002", mi.rel, call.lineno, sym,
+                f"jit site `{key}` is not registered — add it to "
+                "analysis/jit_registry.py with an expected retrace "
+                "budget (what bounds recompiles at this site?)",
+            ))
+            continue
+        if entry.update_step and not _has_donate(call):
+            out.append(Finding(
+                "CST-DON-001", mi.rel, call.lineno, sym,
+                f"update-step jit site `{key}` does not donate its "
+                "TrainState (donate_argnums) — peak memory doubles "
+                "and the TestBufferDonation aliasing pin will fail",
+            ))
+        if not entry.update_step and _has_donate(call) and not entry.donates:
+            out.append(Finding(
+                "CST-DON-001", mi.rel, call.lineno, sym,
+                f"jit site `{key}` donates buffers but its registry "
+                "entry does not declare `donates=True` — donation "
+                "invalidates the caller's input arrays; declare it "
+                "so reviewers see the aliasing contract",
+            ))
+    for key in sorted(jit_registry.JIT_SITE_REGISTRY):
+        if key not in seen_keys:
+            out.append(Finding(
+                "CST-DON-003", "analysis/jit_registry.py", 1, key,
+                f"stale jit-registry entry `{key}` matches no site — "
+                "the code moved; update or remove the entry",
+            ))
+    return out

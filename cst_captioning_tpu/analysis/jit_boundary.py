@@ -1,0 +1,332 @@
+"""CST-JIT: host-state and control-flow audit of traced code.
+
+A ``jax.jit``/``pjit``/``shard_map``-traced function runs ONCE at trace
+time; host-state calls inside it (clocks, host RNG, printing, ``.item()``
+syncs) silently bake a single value into the compiled graph or defeat
+the dispatch pipelining the serving/training layers were built around,
+and a Python ``if`` on a traced value is a TracerBoolConversionError at
+best and a shape-specialized silent miscompile at worst.  This checker:
+
+1. collects every traced ROOT — functions decorated with a jit-flavored
+   transform (``@jax.jit``, ``@functools.partial(jax.jit, ...)``,
+   ``@pjit``, ``shard_map``) or passed by name to one
+   (``jax.jit(train_step, ...)``), plus lambdas jitted inline;
+2. expands the traced set over the intra-package call graph (including
+   flax ``.apply(..., method=...)`` indirection and defs nested inside
+   traced bodies);
+3. inside traced code flags:
+
+   * CST-JIT-001 — host-state calls: ``time.*``, ``np.random.*`` /
+     stdlib ``random.*``, ``print``, ``.item()`` / ``.tolist()``;
+   * CST-JIT-002 — a Python ``if``/``while``/ternary whose test reads a
+     likely-traced parameter (not declared static via
+     ``static_argnums``/``static_argnames``, and not an obviously
+     host-static test — ``is None``, ``isinstance``, ``.shape``/
+     ``.ndim``/``.dtype`` reads, string-constant comparisons, ``self``
+     config reads);
+   * CST-JIT-003 — iteration over a ``set`` (the one builtin whose
+     iteration order is hash-seed dependent — a nondeterministic trace).
+
+CST-JIT-002 is a heuristic by construction (tracedness is a runtime
+property); false positives go in the suppression file WITH justification
+— that annotation is the documentation the invariant wants.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from cst_captioning_tpu.analysis.astutil import (
+    FuncInfo,
+    ModuleInfo,
+    call_name,
+    dotted,
+    walk_body,
+)
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+
+# Callees that trace their function argument.
+_JIT_NAMES = {
+    "jax.jit", "jit", "pjit", "jax.experimental.pjit.pjit",
+}
+_TRACING_WRAPPERS = _JIT_NAMES | {
+    "shard_map", "jax.shard_map", "jax.experimental.shard_map.shard_map",
+}
+_PARTIAL_NAMES = {"functools.partial", "partial"}
+
+_HOST_CALL_PREFIXES = ("time.", "np.random.", "numpy.random.")
+_HOST_SYNC_ATTRS = {"item", "tolist"}
+
+# Test shapes that are host-static even when they mention a parameter.
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+_STATIC_CALLS = {"isinstance", "len", "hasattr", "getattr", "callable"}
+
+
+def _jit_call_static(node: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """static_argnums / static_argnames of a jit(...) call."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in node.keywords:
+        v = kw.value
+        vals: List = []
+        if isinstance(v, (ast.Tuple, ast.List)):
+            vals = [
+                e.value for e in v.elts if isinstance(e, ast.Constant)
+            ]
+        elif isinstance(v, ast.Constant):
+            vals = [v.value]
+        if kw.arg == "static_argnums":
+            nums.update(x for x in vals if isinstance(x, int))
+        elif kw.arg == "static_argnames":
+            names.update(x for x in vals if isinstance(x, str))
+    return nums, names
+
+
+def _jit_flavor(node: ast.AST) -> Optional[ast.Call]:
+    """If ``node`` (a decorator or callee expression) is a jit-flavored
+    transform application, return the Call carrying its kwargs (or a
+    bare marker Call-less None handled by caller)."""
+    if isinstance(node, ast.Call):
+        name = call_name(node)
+        if name in _TRACING_WRAPPERS:
+            return node
+        if name in _PARTIAL_NAMES and node.args:
+            if dotted(node.args[0]) in _TRACING_WRAPPERS:
+                return node
+    return None
+
+
+class _TracedSet:
+    """Traced functions + the static params known per function."""
+
+    def __init__(self) -> None:
+        self.static: Dict[Tuple[str, str], Set[str]] = {}
+        self.reason: Dict[Tuple[str, str], str] = {}
+        # jit ROOTS: the function IS the jit boundary, so every
+        # non-static parameter is traced by construction (CST-JIT-002
+        # applies only here — a transitive callee's params are usually
+        # closure-static python config, not tracers)
+        self.roots: Set[Tuple[str, str]] = set()
+
+    def key(self, fn: FuncInfo) -> Tuple[str, str]:
+        return (fn.module.rel, fn.qualname)
+
+    def add(
+        self, fn: FuncInfo, reason: str,
+        static_names: Optional[Set[str]] = None,
+        *, root: bool = False,
+    ) -> bool:
+        k = self.key(fn)
+        if root:
+            self.roots.add(k)
+        if k in self.static:
+            if static_names:
+                self.static[k] |= static_names
+            return False
+        self.static[k] = set(static_names or ())
+        self.reason[k] = reason
+        return True
+
+    def __contains__(self, fn: FuncInfo) -> bool:
+        return self.key(fn) in self.static
+
+
+def _collect_roots(modules: List[ModuleInfo], traced: _TracedSet) -> None:
+    for mi in modules:
+        for qn, fn in mi.functions.items():
+            node = fn.node
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if dotted(dec) in _TRACING_WRAPPERS:
+                        traced.add(fn, f"@{dotted(dec)}", root=True)
+                        continue
+                    call = _jit_flavor(dec)
+                    if call is not None:
+                        nums, names = _jit_call_static(call)
+                        params = fn.params
+                        for i in nums:
+                            if i < len(params):
+                                names.add(params[i])
+                        traced.add(fn, f"@{call_name(call)}", names, root=True)
+        # jitted-by-call: jax.jit(fn_name, ...) / shard_map(fn, ...)
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name not in _TRACING_WRAPPERS:
+                continue
+            if not node.args:
+                continue
+            target = node.args[0]
+            nums, names = _jit_call_static(node)
+            if isinstance(target, ast.Name):
+                scope = mi.qualname_of(node)
+                cands = []
+                if scope != "<module>":
+                    cands.append(f"{scope}.{target.id}")
+                    # enclosing chain
+                    parts = scope.split(".")
+                    for i in range(len(parts) - 1, 0, -1):
+                        cands.append(
+                            ".".join(parts[:i]) + f".{target.id}"
+                        )
+                cands.append(target.id)
+                for qn in cands:
+                    fn = mi.functions.get(qn)
+                    if fn is not None:
+                        params = fn.params
+                        for i in nums:
+                            if i < len(params):
+                                names.add(params[i])
+                        traced.add(fn, f"{name}(…) call", names, root=True)
+                        break
+            elif isinstance(target, ast.Lambda):
+                for qn, fn in mi.functions.items():
+                    if fn.node is target:
+                        traced.add(fn, f"{name}(lambda)", root=True)
+                        break
+
+
+def _expand(
+    modules: List[ModuleInfo], ctx: CheckContext, traced: _TracedSet
+) -> None:
+    """Close the traced set over nested defs + the package call graph."""
+    by_mod = {m.rel: m for m in modules}
+    work = [
+        by_mod[rel].functions[qn]
+        for (rel, qn) in list(traced.static)
+        if rel in by_mod
+    ]
+    while work:
+        fn = work.pop()
+        mi = fn.module
+        # nested defs are traced with their parent
+        prefix = fn.qualname + "."
+        for qn, sub in mi.functions.items():
+            if qn.startswith(prefix) and sub not in traced:
+                traced.add(sub, f"nested in traced {fn.qualname}")
+                work.append(sub)
+        for call in (
+            n for n in walk_body(fn) if isinstance(n, ast.Call)
+        ):
+            for callee in ctx.index.resolve_call(mi, fn, call):
+                if callee not in traced:
+                    traced.add(
+                        callee,
+                        f"called from traced {mi.rel}::{fn.qualname}",
+                    )
+                    work.append(callee)
+
+
+def _test_is_static(test: ast.AST) -> bool:
+    """Host-static test shapes: shape/dtype reads, None checks,
+    isinstance/len, string-constant comparisons, self/config reads
+    only."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            if any(
+                isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                for op in node.ops
+            ):
+                return True
+            sides = [node.left, *node.comparators]
+            if any(
+                isinstance(s, ast.Constant) and isinstance(s.value, str)
+                for s in sides
+            ):
+                return True
+            if any(
+                isinstance(s, (ast.Tuple, ast.List))
+                and all(isinstance(e, ast.Constant) for e in s.elts)
+                for s in sides
+            ):
+                return True
+        if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(node, ast.Call):
+            if call_name(node) in _STATIC_CALLS:
+                return True
+    return False
+
+
+def _traced_param_in_test(
+    test: ast.AST, fn: FuncInfo, static_names: Set[str]
+) -> Optional[str]:
+    params = {
+        p for p in fn.params
+        if p not in ("self", "cls") and p not in static_names
+    }
+    for node in ast.walk(test):
+        if isinstance(node, ast.Name) and node.id in params:
+            return node.id
+    return None
+
+
+@register_checker("jit_boundary")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    traced = _TracedSet()
+    _collect_roots(modules, traced)
+    _expand(modules, ctx, traced)
+
+    out: List[Finding] = []
+    by_mod = {m.rel: m for m in modules}
+    for (rel, qn), static_names in sorted(traced.static.items()):
+        mi = by_mod.get(rel)
+        if mi is None:
+            continue
+        fn = mi.functions[qn]
+        for node in walk_body(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name == "print" or name.startswith(_HOST_CALL_PREFIXES):
+                    out.append(Finding(
+                        "CST-JIT-001", rel, node.lineno, qn,
+                        f"host-state call `{name}(…)` inside traced "
+                        f"code ({traced.reason[(rel, qn)]}) — the value "
+                        "is baked in at trace time; hoist it out of "
+                        "the jit boundary or thread it as an argument",
+                    ))
+                elif (
+                    isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _HOST_SYNC_ATTRS
+                    and not node.args
+                ):
+                    out.append(Finding(
+                        "CST-JIT-001", rel, node.lineno, qn,
+                        f"`.{node.func.attr}()` inside traced code — "
+                        "a device sync cannot execute under trace; "
+                        "return the array and read it on the host",
+                    ))
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)) and (
+                (rel, qn) in traced.roots
+            ):
+                test = node.test
+                if _test_is_static(test):
+                    continue
+                p = _traced_param_in_test(test, fn, static_names)
+                if p is not None:
+                    out.append(Finding(
+                        "CST-JIT-002", rel, test.lineno, qn,
+                        f"Python `{type(node).__name__.lower()}` on "
+                        f"parameter `{p}` inside traced code — a "
+                        "traced value cannot branch host control flow; "
+                        "use lax.cond/jnp.where, or declare the "
+                        "argument static",
+                    ))
+            if isinstance(node, (ast.For, ast.comprehension)):
+                it = node.iter
+                if isinstance(it, ast.Set) or (
+                    isinstance(it, ast.Call) and call_name(it) == "set"
+                ):
+                    out.append(Finding(
+                        "CST-JIT-003", rel, it.lineno, qn,
+                        "iteration over a set inside traced code — "
+                        "set order is hash-seed dependent, so the "
+                        "traced graph is nondeterministic; sort it",
+                    ))
+    return out

@@ -1,0 +1,138 @@
+"""The jit call-site registry: every jit application in the package,
+keyed stably, with its expected retrace budget.
+
+``budget`` is reviewer-facing prose answering ONE question: what bounds
+recompiles at this site?  (A fixed shape ladder, a pre-warmed bank
+ladder, a handful of static values, a once-per-process probe…)  The
+CST-DON-002 rule fails the analysis pass on any unregistered site, and
+CST-DON-003 on stale entries, so this file tracks the code by
+construction.  ``update_step=True`` marks TrainState update steps that
+MUST donate their state (CST-DON-001, paired with the
+``tf.aliasing_output`` pin in tests/test_training.py);
+``donates=True`` acknowledges donation at non-update sites.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, NamedTuple
+
+
+class JitSite(NamedTuple):
+    budget: str
+    update_step: bool = False
+    donates: bool = False
+
+
+JIT_SITE_REGISTRY: Dict[str, JitSite] = {
+    # ---------------------------------------------------------- decoding
+    "decoding/beam.py::make_beam_search_fn::fn": JitSite(
+        "one compile per (B, K, L) decode shape; offline eval uses one "
+        "shape, serving dispatches through the engine's fixed batch "
+        "ladder (warmup pre-compiles every rung)"
+    ),
+    # ------------------------------------------------------ fused kernels
+    "ops/pallas_beam.py::attlstm_beam": JitSite(
+        "static (beam_size, max_len, suppress_unk) + input shapes: one "
+        "compile per eval/bench configuration, reused for the whole run"
+    ),
+    "ops/pallas_beam.py::lstm_beam": JitSite(
+        "same static-knob discipline as attlstm_beam (meanpool fusion "
+        "variant)"
+    ),
+    "ops/pallas_sampler.py::attlstm_sample": JitSite(
+        "static (max_len, greedy, suppress_unk) + shapes; temperature "
+        "is an SMEM scalar by design (ADVICE r5 #1) so distinct "
+        "temperatures share ONE compiled kernel"
+    ),
+    "ops/pallas_sampler.py::lstm_sample": JitSite(
+        "same discipline as attlstm_sample (meanpool fusion variant)"
+    ),
+    # ----------------------------------------------------------- serving
+    "serving/engine.py::InferenceEngine._encode_fn.encode": JitSite(
+        "one compile per ladder bucket B, all built at warmup(); the "
+        "coalescer never builds a batch outside the ladder"
+    ),
+    "serving/engine.py::InferenceEngine._state_fn.from_state": JitSite(
+        "one compile per ladder bucket B (tier-2 fast path), built at "
+        "warmup()"
+    ),
+    "serving/slots.py::SlotDecoder._tick_fn.tick": JitSite(
+        "one compile per (bank size S, admit bucket A) pair; warmup() "
+        "builds every variant and SlotDecoder.compile_count pins that "
+        "post-warmup traffic builds ZERO new ones (tier-1)"
+    ),
+    "serving/slots.py::SlotDecoder._free_fn.free_rows": JitSite(
+        "one compile per bank size, warmup-built, compile_count-pinned"
+    ),
+    "serving/slots.py::SlotDecoder._resize_fn.resize": JitSite(
+        "one compile per bank-ladder transition (grow+shrink), "
+        "warmup-built, compile_count-pinned"
+    ),
+    # ---------------------------------------------------------- training
+    "training/steps.py::make_xe_train_step::train_step": JitSite(
+        "one compile per distinct static ss_prob value (the scheduled-"
+        "sampling schedule steps a handful of times per run) at the "
+        "fixed train batch shape",
+        update_step=True,
+    ),
+    "training/steps.py::make_greedy_sample_fn::sample": JitSite(
+        "one compile at the fixed validation batch shape"
+    ),
+    "training/cst.py::dispatch_latency_ms::<lambda>": JitSite(
+        "one trivial probe compile per process (dispatch-latency "
+        "measurement)"
+    ),
+    "training/cst.py::io_callback_supported::<lambda>": JitSite(
+        "one capability-probe compile per process"
+    ),
+    "training/cst.py::_make_one_graph_step::train_step": JitSite(
+        "one compile at the fixed CST batch shape",
+        update_step=True,
+    ),
+    "training/cst.py::_make_pipelined_step::_rollout": JitSite(
+        "one compile at the fixed rollout batch shape (pipelined "
+        "layout's first dispatch)"
+    ),
+    "training/cst.py::_make_pipelined_step.update_and_rollout": JitSite(
+        "one compile at the fixed CST batch shape (steady-state "
+        "pipelined step)",
+        update_step=True,
+    ),
+    "training/cst.py::_make_pipelined_step.update_only": JitSite(
+        "one compile at the fixed CST batch shape (pipeline flush)",
+        update_step=True,
+    ),
+    "training/cst.py::_make_split_step.rollout_chunk": JitSite(
+        "one compile per rollout chunk shape (fixed chunking of the "
+        "fixed batch)"
+    ),
+    "training/cst.py::_make_split_step.rollout_fused": JitSite(
+        "one compile at the fixed batch shape (fused-sampler variant)"
+    ),
+    "training/cst.py::_make_split_step.greedy_chunk": JitSite(
+        "one compile at the fixed greedy-baseline batch shape"
+    ),
+    "training/cst.py::_make_split_step.update_fn": JitSite(
+        "one compile per power-of-two trimmed PG length bucket at the "
+        "fixed batch shape",
+        update_step=True,
+    ),
+    "training/cst.py::SlotRollout.__init__::prepare": JitSite(
+        "static (repeat, need_greedy): one compile per rollout "
+        "configuration at the fixed batch shape"
+    ),
+    "training/cst.py::SlotRollout._tick_fn.tick": JitSite(
+        "one compile per slot-rollout geometry (n_slots, block) — a "
+        "single full-width admission bucket, fixed per run"
+    ),
+    "training/cst.py::_make_slot_step.update_fn": JitSite(
+        "one compile per power-of-two trimmed PG length bucket "
+        "(identical trim to the padded layout)",
+        update_step=True,
+    ),
+    # ------------------------------------------------------------- tools
+    "tools/overlap_sim.py::simulate::<lambda>": JitSite(
+        "bench-only overlap simulator: one compile per simulated shape "
+        "per bench invocation"
+    ),
+}

@@ -1,0 +1,145 @@
+"""CST-MET: Prometheus metric-name registry lint.
+
+Dashboards and the bench sweeps scrape ``/metrics`` by NAME; a renamed,
+duplicated, or undocumented series breaks them silently.  The registry
+(``serving/metrics.py::METRIC_FAMILIES`` — runtime-visible, next to the
+emitters) is the single source of truth; these rules keep it honest:
+
+* CST-MET-001 — a ``caption_*`` name emitted anywhere in ``serving/``
+  that matches no registered family (f-string placeholders normalize to
+  ``*``, label blocks and space-separated values are stripped);
+* CST-MET-002 — a registered family missing from docs/SERVING.md (the
+  docs table must name every family verbatim);
+* CST-MET-003 — a family registered more than once, or two registered
+  patterns that shadow each other exactly.
+
+``serving/metrics.py`` is stdlib-only by design, so importing the
+registry here keeps the analysis pass jax-free.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from fnmatch import fnmatchcase
+from typing import List, Optional, Tuple
+
+from cst_captioning_tpu.analysis.astutil import ModuleInfo
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+
+_NAME_RE = re.compile(r"^caption_[a-z0-9_*]+$")
+REGISTRY_FILE = "serving/metrics.py"
+DOC_FILE = "SERVING.md"
+
+
+def _load_registry() -> List[Tuple[str, str]]:
+    from cst_captioning_tpu.serving.metrics import METRIC_FAMILIES
+
+    return list(METRIC_FAMILIES)
+
+
+def _normalize(raw: str) -> Optional[str]:
+    """A candidate emitted-name literal -> canonical family string.
+    Placeholders are already ``*``; strip the label block and anything
+    after the first space, then the exposition suffixes."""
+    name = raw.split("{", 1)[0].split(" ", 1)[0]
+    for suffix in ("_bucket", "_count", "_sum"):
+        if name.endswith(suffix):
+            name = name[: -len(suffix)]
+    if not _NAME_RE.match(name):
+        return None
+    return name
+
+
+def _literal_strings(mi: ModuleInfo):
+    """(string value, line) for every Constant str and every JoinedStr
+    with FormattedValues replaced by ``*`` — skipping docstrings (prose
+    mentions are documentation, not emission)."""
+    skip = set()
+    for node in ast.walk(mi.tree):
+        if isinstance(
+            node,
+            (ast.Module, ast.ClassDef, ast.FunctionDef, ast.AsyncFunctionDef),
+        ):
+            body = node.body
+            if (
+                body
+                and isinstance(body[0], ast.Expr)
+                and isinstance(body[0].value, ast.Constant)
+                and isinstance(body[0].value.value, str)
+            ):
+                skip.add(id(body[0].value))
+        elif isinstance(node, ast.JoinedStr):
+            # constant fragments of an f-string are surfaced via the
+            # normalized JoinedStr, not as bare literals
+            for v in node.values:
+                skip.add(id(v))
+    for node in ast.walk(mi.tree):
+        if (
+            isinstance(node, ast.Constant)
+            and isinstance(node.value, str)
+            and id(node) not in skip
+        ):
+            yield node.value, node.lineno
+        elif isinstance(node, ast.JoinedStr):
+            parts = []
+            for v in node.values:
+                if isinstance(v, ast.Constant):
+                    parts.append(str(v.value))
+                else:
+                    parts.append("*")
+            yield "".join(parts), node.lineno
+
+
+@register_checker("metrics_registry")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    out: List[Finding] = []
+    registry = _load_registry()
+
+    # MET-003: duplicate registration
+    seen = {}
+    for i, (pattern, typ) in enumerate(registry):
+        if pattern in seen:
+            out.append(Finding(
+                "CST-MET-003", REGISTRY_FILE, 1,
+                f"METRIC_FAMILIES[{i}]",
+                f"metric family `{pattern}` registered more than once",
+            ))
+        seen[pattern] = typ
+
+    patterns = [p for p, _ in registry]
+
+    # MET-001: every emitted caption_* literal matches a family
+    for mi in modules:
+        if not mi.rel.startswith("serving/"):
+            continue
+        for raw, line in _literal_strings(mi):
+            name = _normalize(raw)
+            if name is None:
+                continue
+            if not any(fnmatchcase(name, p) or name == p for p in patterns):
+                out.append(Finding(
+                    "CST-MET-001", mi.rel, line, name,
+                    f"emitted metric name `{name}` matches no "
+                    "registered family — register it in "
+                    "serving/metrics.py::METRIC_FAMILIES and document "
+                    "it in docs/SERVING.md",
+                ))
+
+    # MET-002: every family documented in docs/SERVING.md
+    if ctx.docs_root is not None:
+        doc_path = ctx.docs_root / DOC_FILE
+        doc_text = doc_path.read_text() if doc_path.exists() else ""
+        for pattern, _typ in registry:
+            if pattern not in doc_text:
+                out.append(Finding(
+                    "CST-MET-002", REGISTRY_FILE, 1, pattern,
+                    f"registered metric family `{pattern}` is not "
+                    f"documented in docs/{DOC_FILE} — scrape consumers "
+                    "discover names there; add it to the metrics table",
+                ))
+    return out

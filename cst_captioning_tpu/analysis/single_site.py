@@ -1,0 +1,211 @@
+"""CST-DEC: single-definition-site rules for the decode recurrence.
+
+The repo's hardest-won invariant (PR 6) is that the per-step decode
+recurrence exists exactly once — ``decoding/core.py::decode_step`` —
+and (PR 7) that admission paths never re-grow the K× replicated
+``DecodeCache`` layout the dedup removed.  Both used to be guarded by
+regex fingerprints over comment-stripped source
+(tests/test_decode_core.py); these AST rules replace them and survive
+reformatting, aliasing (``from jax.lax import top_k``), and line
+wrapping.
+
+Rules (allowlists are CONSCIOUS extension points — the fused Pallas
+kernel bodies and their bit-exact XLA twins keep in-kernel recurrences
+by necessity):
+
+* CST-DEC-001 — a ``top_k`` call (the beam-selection recurrence)
+  outside :data:`TOP_K_ALLOWED`.
+* CST-DEC-002 — the finish update ``(tok == EOS_ID) | (tok == PAD_ID)``
+  outside :data:`FINISH_ALLOWED`.
+* CST-DEC-003 — the PAD→EOS feed ``where(x == PAD_ID, EOS_ID, ...)``
+  outside :data:`FEED_ALLOWED`.
+* CST-DEC-004 — ``jnp.repeat``-style cache replication outside
+  :data:`REPEAT_ALLOWED` (the PR-7 K× decode-state memory regression).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from cst_captioning_tpu.analysis.astutil import ModuleInfo, dotted
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+
+# Files allowed to contain each pattern.  Removing an entry that still
+# holds the pattern makes the pass fail at the exact file:line —
+# pinned by tests/test_analysis.py.
+TOP_K_ALLOWED = frozenset({
+    "decoding/core.py",
+    "ops/pallas_beam.py",
+})
+FINISH_ALLOWED = frozenset({
+    "decoding/core.py",
+    "ops/pallas_beam.py",
+    "ops/pallas_sampler.py",
+})
+# training/cst.py: the PG update's input shift, not a decode loop.
+FEED_ALLOWED = frozenset({
+    "decoding/core.py",
+    "ops/pallas_beam.py",
+    "ops/pallas_sampler.py",
+    "training/cst.py",
+})
+# Allowed jnp.repeat fan-outs: the offline beam expansion (beam.py),
+# the seq_per_img rollout fan-out (captioner.py), the fused kernels'
+# twins, the CST reward broadcast (cst.py), and slots.py's flag-gated
+# legacy replicated layout (serving.dedup_cache=false).
+REPEAT_ALLOWED = frozenset({
+    "decoding/beam.py",
+    "models/captioner.py",
+    "ops/pallas_beam.py",
+    "training/cst.py",
+    "serving/slots.py",
+})
+
+_EOS_NAMES = {"EOS_ID"}
+_PAD_NAMES = {"PAD_ID"}
+
+
+def _cmp_against(node: ast.AST, names: frozenset) -> bool:
+    """True for ``X == NAME`` / ``NAME == X`` Compare nodes."""
+    if not (isinstance(node, ast.Compare) and len(node.ops) == 1):
+        return False
+    if not isinstance(node.ops[0], ast.Eq):
+        return False
+    sides = [node.left, node.comparators[0]]
+    return any(
+        isinstance(s, ast.Name) and s.id in names for s in sides
+    )
+
+
+def _finish_update(node: ast.AST) -> bool:
+    """``(x == EOS_ID) | (y == PAD_ID)`` in either order, possibly
+    nested in a wider BitOr chain, or the bool-op spelling."""
+    terms: List[ast.AST] = []
+
+    def flatten(n: ast.AST) -> None:
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.BitOr):
+            flatten(n.left)
+            flatten(n.right)
+        elif isinstance(n, ast.BoolOp) and isinstance(n.op, ast.Or):
+            for v in n.values:
+                flatten(v)
+        else:
+            terms.append(n)
+
+    if not (
+        (isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr))
+        or (isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or))
+    ):
+        return False
+    flatten(node)
+    has_eos = any(_cmp_against(t, frozenset(_EOS_NAMES)) for t in terms)
+    has_pad = any(_cmp_against(t, frozenset(_PAD_NAMES)) for t in terms)
+    return has_eos and has_pad
+
+
+def _pad_eos_feed(node: ast.Call) -> bool:
+    """``where(x == PAD_ID, EOS_ID, ...)`` — any where-flavored callee
+    (jnp.where, np.where, bare where)."""
+    callee = dotted(node.func)
+    if not callee.split(".")[-1] == "where":
+        return False
+    if len(node.args) < 2:
+        return False
+    cond, then = node.args[0], node.args[1]
+    return (
+        _cmp_against(cond, frozenset(_PAD_NAMES))
+        and isinstance(then, ast.Name)
+        and then.id in _EOS_NAMES
+    )
+
+
+def _resolved_callee(mi: ModuleInfo, node: ast.Call) -> str:
+    """Dotted callee with its head resolved through the module's import
+    map, so ``from jax.lax import top_k as tk; tk(...)`` still names
+    ``jax.lax.top_k``."""
+    callee = dotted(node.func)
+    head, dot, rest = callee.partition(".")
+    target = mi.imports.get(head)
+    if target:
+        return target + (("." + rest) if rest else "")
+    return callee
+
+
+def _is_top_k(mi: ModuleInfo, node: ast.Call) -> bool:
+    callee = _resolved_callee(mi, node)
+    return bool(callee) and callee.split(".")[-1] == "top_k"
+
+
+def _is_repeat(node: ast.Call) -> bool:
+    """``jnp.repeat`` / ``np.repeat`` / aliased ``repeat`` imported from
+    a numpy-flavored module — NOT ``str.repeat``-style methods on
+    arbitrary objects (``x.repeat(...)`` with a non-module receiver is
+    torch idiom that doesn't occur here; a bare attribute ``.repeat``
+    on a Name receiver counts only for the known array-module aliases)."""
+    callee = dotted(node.func)
+    if callee in ("jnp.repeat", "np.repeat", "numpy.repeat", "repeat"):
+        return True
+    return callee.endswith(".repeat") and callee.split(".")[0] in (
+        "jnp", "np", "jax", "numpy",
+    )
+
+
+@register_checker("single_site")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    out: List[Finding] = []
+    for mi in modules:
+        for node in ast.walk(mi.tree):
+            if isinstance(node, ast.Call):
+                if _is_top_k(mi, node) and mi.rel not in TOP_K_ALLOWED:
+                    out.append(Finding(
+                        "CST-DEC-001", mi.rel, node.lineno,
+                        mi.qualname_of(node),
+                        "beam-selection recurrence (top_k) outside "
+                        "decoding/core.py — import "
+                        "decoding.core.decode_step instead (kernel "
+                        "bodies: extend TOP_K_ALLOWED consciously)",
+                    ))
+                if _pad_eos_feed(node) and mi.rel not in FEED_ALLOWED:
+                    out.append(Finding(
+                        "CST-DEC-003", mi.rel, node.lineno,
+                        mi.qualname_of(node),
+                        "PAD→EOS feed of finished rows re-implemented "
+                        "outside decoding/core.py",
+                    ))
+                if _is_repeat(node) and mi.rel not in REPEAT_ALLOWED:
+                    out.append(Finding(
+                        "CST-DEC-004", mi.rel, node.lineno,
+                        mi.qualname_of(node),
+                        "jnp.repeat-style replication outside the "
+                        "allowlist — replicating cached decode state "
+                        "at admission is the K× memory regression the "
+                        "deduped slot layout removed (PR 7); read the "
+                        "shared row via row//K instead",
+                    ))
+            elif (
+                _finish_update(node)
+                and mi.rel not in FINISH_ALLOWED
+                # only the OUTERMOST node of an |-chain fires (a nested
+                # sub-chain would double-report one expression)
+                and not (
+                    (p := mi.parent.get(node)) is not None
+                    and (
+                        (isinstance(p, ast.BinOp)
+                         and isinstance(p.op, ast.BitOr))
+                        or (isinstance(p, ast.BoolOp)
+                            and isinstance(p.op, ast.Or))
+                    )
+                )
+            ):
+                out.append(Finding(
+                    "CST-DEC-002", mi.rel, node.lineno,
+                    mi.qualname_of(node),
+                    "EOS/PAD finish update re-implemented outside "
+                    "decoding/core.py",
+                ))
+    return out

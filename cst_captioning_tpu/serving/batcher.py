@@ -71,6 +71,14 @@ class ShuttingDownError(Exception):
 
 
 class _Pending:
+    # Single-owner contract (checked by the CST-THR analysis rules): a
+    # _Pending belongs to exactly one scheduler thread at any moment —
+    # it is handed between queues only under the batcher/replica-set
+    # _cond, and the owning worker alone writes t_admit.  The
+    # submitter's only touchpoint is the (internally synchronized)
+    # Future.
+    _analysis_single_owner = True
+
     __slots__ = ("prepared", "future", "t_enqueue", "t_admit", "deadline")
 
     def __init__(self, prepared, deadline: float):
@@ -157,13 +165,18 @@ class _BatcherBase:
             self._draining = True
             self._drain = drain
             self._stop = True
+            t = self._thread
             self._cond.notify_all()
-        if self._thread is not None:
-            self._thread.join(timeout=self.drain_timeout_s + 60.0)
-            self._thread = None
+        # Join OUTSIDE the lock: the scheduler thread needs _cond to
+        # observe the stop and exit.  CST-THR-002: the handle is read
+        # and cleared under _cond so concurrent stop() callers race on
+        # an idempotent join, never on a torn handle.
+        if t is not None:
+            t.join(timeout=self.drain_timeout_s + 60.0)
         # Fail anything still queued so no submitter blocks forever
         # (drain disabled, drain deadline blown, or scheduler death).
         with self._cond:
+            self._thread = None
             while self._q:
                 p = self._q.popleft()
                 if not p.future.done():

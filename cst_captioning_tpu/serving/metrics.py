@@ -39,6 +39,42 @@ STEP_BUCKETS = [
     28.0, 32.0, 48.0, 64.0,
 ]
 
+# The Prometheus name registry: every family this subsystem may emit,
+# exactly once, as (name_pattern, type).  ``*`` stands for a computed
+# segment (stage name, cache tier/stat key, histogram suffix).  The
+# CST-MET analysis rules enforce that (a) every name emitted anywhere
+# in serving/ matches a registered family, (b) every family is
+# documented in docs/SERVING.md, and (c) no family is registered twice
+# — so a new metric is added HERE and in the docs or tier-1 fails.
+METRIC_FAMILIES = [
+    ("caption_requests_total", "counter"),
+    ("caption_requests_served_total", "counter"),
+    ("caption_requests_rejected_total", "counter"),
+    ("caption_requests_expired_total", "counter"),
+    ("caption_requests_failed_total", "counter"),
+    ("caption_batches_total", "counter"),
+    ("caption_batch_rows_total", "counter"),
+    ("caption_batch_pad_rows_total", "counter"),
+    ("caption_slots_admitted_total", "counter"),
+    ("caption_slot_device_steps_total", "counter"),
+    ("caption_slot_bank_resizes_total", "counter"),
+    ("caption_slots_total", "gauge"),
+    ("caption_slots_occupied", "gauge"),
+    ("caption_decode_state_bytes", "gauge"),
+    ("caption_slot_bank_size", "gauge"),
+    ("caption_replica_healthy", "gauge"),
+    ("caption_replica_slots_occupied", "gauge"),
+    ("caption_replica_queue_depth", "gauge"),
+    ("caption_replica_captions_total", "counter"),
+    ("caption_replica_admitted_total", "counter"),
+    ("caption_replica_device_steps_total", "counter"),
+    ("caption_replica_decode_state_bytes", "gauge"),
+    ("caption_replica_slot_bank_size", "gauge"),
+    ("caption_latency_*_ms", "histogram"),
+    ("caption_steps_per_caption", "histogram"),
+    ("caption_cache_*", "gauge"),
+]
+
 
 class Counter:
     """Thread-safe monotonically-increasing counter."""
@@ -326,13 +362,13 @@ class ServingMetrics:
                     lines.append(
                         f'{name}{{replica="{rid}"}} {read(rm)}'
                     )
-        hists = dict(
-            {
+        hists = {
+            **{
                 f"caption_latency_{s}_ms": h
                 for s, h in self.stages.items()
             },
-            caption_steps_per_caption=self.steps_per_caption,
-        )
+            "caption_steps_per_caption": self.steps_per_caption,
+        }
         for name, h in hists.items():
             lines.append(f"# TYPE {name} histogram")
             cum = 0

@@ -227,13 +227,16 @@ class ReplicaSet(_BatcherBase):
             self._draining = True
             self._drain = drain
             self._stop = True
+            threads = list(self._threads)
             self._cond.notify_all()
-        for t in self._threads:
+        # Join OUTSIDE the lock — workers need _cond to observe the
+        # stop and drain out.
+        for t in threads:
             t.join(timeout=self.drain_timeout_s + 60.0)
-        self._threads = []
         # Fail anything still queued anywhere (drain disabled, drain
         # deadline blown, or worker death) so no submitter blocks.
         with self._cond:
+            self._threads = []
             for rep in self.replicas:
                 while rep.q:
                     p = rep.q.popleft()

@@ -170,7 +170,17 @@ class _Server(ThreadingHTTPServer):
     engine: InferenceEngine
     batcher: Any
     metrics: ServingMetrics
-    draining: bool = False
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        # Drain flag read by every handler thread and flipped by
+        # control threads (SIGTERM handler, context exits) — an Event,
+        # not a bare bool, so the cross-thread handoff is explicit.
+        self._draining_evt = threading.Event()
+
+    @property
+    def draining(self) -> bool:
+        return self._draining_evt.is_set()
 
 
 class CaptionServer:
@@ -262,7 +272,7 @@ class CaptionServer:
     def begin_drain(self) -> None:
         """Close admissions: new HTTP requests get 503, the batcher
         rejects new submits; in-flight work keeps running."""
-        self._http.draining = True
+        self._http._draining_evt.set()
         self.batcher.begin_drain()
 
     def shutdown(self, drain: bool = True) -> None:
@@ -274,13 +284,15 @@ class CaptionServer:
             if self._closed:
                 return
             self._closed = True
-        self.begin_drain()
-        self.batcher.stop(drain=drain)
-        self._http.shutdown()
-        self._http.server_close()
-        if self._thread is not None:
-            self._thread.join(timeout=10.0)
-            self._thread = None
+            self.begin_drain()
+            self.batcher.stop(drain=drain)
+            self._http.shutdown()
+            self._http.server_close()
+            t, self._thread = self._thread, None
+        # Join outside the lock so a second (already-returned) caller
+        # is never serialized behind the listener teardown.
+        if t is not None:
+            t.join(timeout=10.0)
 
     def __enter__(self) -> "CaptionServer":
         return self.start()

@@ -1,0 +1,40 @@
+# corpus-rules: single_site
+"""Seeded re-implementations of the decode recurrence — every pattern
+the two retired grep fingerprints (tests/test_decode_core.py pre-PR-8)
+used to catch, now as AST shapes the CST-DEC rules must flag in any
+file outside the allowlists."""
+
+import jax
+import jax.numpy as jnp
+from jax.lax import top_k as topk_alias
+
+from cst_captioning_tpu.constants import EOS_ID, PAD_ID
+
+
+def rogue_beam_select(total, K):
+    scores, flat = jax.lax.top_k(total, K)  # expect: CST-DEC-001
+    return scores, flat
+
+
+def rogue_beam_select_aliased(total, K):
+    # reformat/alias-resistant: the old grep needed the literal
+    # ``top_k(`` token; the AST rule resolves the aliased callee too
+    return topk_alias(total, K)  # expect: CST-DEC-001
+
+
+def rogue_finish_update(tok, finished):
+    return finished | (tok == EOS_ID) | (tok == PAD_ID)  # expect: CST-DEC-002
+
+
+def rogue_finish_update_boolop(tok):
+    return (tok == EOS_ID) or (tok == PAD_ID)  # expect: CST-DEC-002
+
+
+def rogue_pad_eos_feed(tok):
+    return jnp.where(tok == PAD_ID, EOS_ID, tok)  # expect: CST-DEC-003
+
+
+def rogue_cache_replication(cache_row, K):
+    # the PR-7 K-by memory regression: fanning cached decode state out
+    # per beam row at admission
+    return jnp.repeat(cache_row, K, axis=0)  # expect: CST-DEC-004

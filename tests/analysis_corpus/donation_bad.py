@@ -1,0 +1,22 @@
+# corpus-rules: donation
+"""Seeded donation/compile-discipline violations: an update step whose
+registry entry demands donation but whose jit call forgot it, and a
+jit site with no registry entry at all.  (The corpus test injects the
+matching registry entry for the first key.)"""
+
+import jax
+
+
+def make_bad_update_step(model):
+    def train_step(state, batch):
+        return state
+
+    # registered update step (injected by the test) WITHOUT donation
+    return jax.jit(train_step)  # expect: CST-DON-001
+
+
+def make_unregistered(model):
+    def mystery(x):
+        return x
+
+    return jax.jit(mystery)  # expect: CST-DON-002

@@ -1,0 +1,63 @@
+# corpus-rules: jit_boundary
+"""Seeded host-state hazards inside traced code: decorated roots,
+jit-by-call roots, transitive callees through the intra-file call
+graph, and the traced-``if`` / set-iteration shapes."""
+
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _helper_with_clock(x):
+    # traced TRANSITIVELY (called from bad_decorated below)
+    t = time.monotonic()  # expect: CST-JIT-001
+    return x + t
+
+
+@jax.jit
+def bad_decorated(x):
+    print("tracing", x)  # expect: CST-JIT-001
+    noise = np.random.rand()  # expect: CST-JIT-001
+    y = _helper_with_clock(x) + noise
+    if x > 0:  # expect: CST-JIT-002
+        y = y * 2
+    return y
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def static_arg_ok(x, flag):
+    # NEGATIVE case: `flag` is static_argnums-declared — branching on
+    # it is fine and must NOT fire CST-JIT-002
+    if flag:
+        return x + 1
+    return x
+
+
+@jax.jit
+def bad_sync(x):
+    v = x.sum().item()  # expect: CST-JIT-001
+    return x / v
+
+
+@jax.jit
+def bad_set_iteration(x):
+    total = x
+    for axis in {0, 1}:  # expect: CST-JIT-003
+        total = total.sum(axis=axis)
+    return total
+
+
+def jitted_by_call(x, y):
+    if y is None:  # NEGATIVE: is-None tests are host-static
+        y = jnp.zeros_like(x)
+    while x.ndim > 2:  # NEGATIVE: shape reads are host-static
+        x = x.sum(0)
+    if y:  # expect: CST-JIT-002
+        x = x + y
+    return x
+
+
+run = jax.jit(jitted_by_call)

@@ -1,0 +1,30 @@
+# corpus-rules: thread_safety
+# corpus-expect-anywhere: CST-THR-001
+"""Seeded lock-order inversion + unguarded shared-state mutation: a
+worker thread takes lock_a then lock_b while the public submit surface
+takes lock_b then lock_a (a latent deadlock the static pass must see),
+and submit bumps a shared counter with no lock at all."""
+
+import threading
+
+
+class InvertedPair:
+    def __init__(self):
+        self.lock_a = threading.Lock()
+        self.lock_b = threading.Lock()
+        self.counter = 0
+        self.workers = []
+        for _ in range(2):
+            t = threading.Thread(target=self._run)
+            self.workers.append(t)
+
+    def _run(self):
+        with self.lock_a:
+            with self.lock_b:
+                self.counter += 1
+
+    def submit(self, item):
+        self.counter += 1  # expect: CST-THR-002
+        with self.lock_b:
+            with self.lock_a:
+                return self.counter + item

@@ -1,0 +1,9 @@
+# corpus-rules: metrics_registry
+"""Seeded unregistered-metric emission: a serving module exporting a
+Prometheus series name that METRIC_FAMILIES doesn't know."""
+
+
+def to_prometheus(value):
+    lines = ["# TYPE caption_bogus_series_total counter"]
+    lines.append(f"caption_bogus_series_total {value}")  # expect: CST-MET-001
+    return "\n".join(lines)

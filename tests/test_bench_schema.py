@@ -206,3 +206,70 @@ class TestMultichipKinds:
     def test_unknown_kind_fails(self):
         with pytest.raises(ValueError, match="unknown record kind"):
             validate_record(good_bench(), kind="nonsense")
+
+
+class TestAnalysisReportSchema:
+    """The invariant engine's --json report rides the same contract
+    discipline as the bench rows: schema-validated at the emit site
+    (analysis.validate_report), exercised here alongside
+    validate_record so the two emitters can't drift apart (ISSUE 8)."""
+
+    def good_report(self):
+        return {
+            "version": 1,
+            "clean": False,
+            "duration_s": 1.42,
+            "files_scanned": 65,
+            "rules_run": ["single_site", "donation"],
+            "findings": [{
+                "rule": "CST-DEC-001", "file": "x.py", "line": 3,
+                "symbol": "f", "message": "top_k outside core",
+            }],
+            "suppressed": [{
+                "rule": "CST-JIT-002", "file": "y.py", "line": 9,
+                "symbol": "g", "message": "traced if",
+                "justification": "argument is a static python flag",
+            }],
+            "unused_suppressions": [],
+        }
+
+    def test_good_report_passes(self):
+        from cst_captioning_tpu.analysis import validate_report
+
+        rec = self.good_report()
+        assert validate_report(rec) is rec
+
+    def test_clean_must_match_findings(self):
+        from cst_captioning_tpu.analysis import validate_report
+
+        rec = self.good_report()
+        rec["clean"] = True        # but findings is non-empty
+        with pytest.raises(ValueError, match="contradicts"):
+            validate_report(rec)
+
+    def test_suppressed_requires_justification(self):
+        from cst_captioning_tpu.analysis import validate_report
+
+        rec = self.good_report()
+        rec["suppressed"][0]["justification"] = "  "
+        with pytest.raises(ValueError, match="justification"):
+            validate_report(rec)
+
+    def test_bool_duration_fails(self):
+        from cst_captioning_tpu.analysis import validate_report
+
+        rec = self.good_report()
+        rec["duration_s"] = True
+        with pytest.raises(ValueError, match="duration_s"):
+            validate_report(rec)
+
+    def test_bench_preflight_extras_are_schema_clean(self):
+        """The preflight's extra fields obey the bench record rules
+        (numeric *_s, int counts — never bools)."""
+        rec = good_bench()
+        rec["extra"]["analysis_findings"] = 0
+        rec["extra"]["analysis_duration_s"] = 1.42
+        validate_record(rec)
+        rec["extra"]["analysis_duration_s"] = True
+        with pytest.raises(ValueError, match="analysis_duration_s"):
+            validate_record(rec)

@@ -10,15 +10,16 @@ test_serving.py.
 
 Plus the single-definition-site guard: the per-step decode recurrence
 exists exactly once (``decoding/core.py::decode_step``); every XLA
-consumer must import it, and a tokenizer-stripped grep fails the build
-if a new module re-implements the step math (the fused kernel bodies
-are the explicit allowlist — a Pallas kernel cannot call back into
-XLA ops).
+consumer must import it, and the CST-DEC analysis rules
+(cst_captioning_tpu/analysis/single_site.py, PR 8 — AST shapes, so
+reformatting/aliasing can't dodge them the way they could dodge the
+retired grep fingerprints) fail the build if a new module re-implements
+the step math (the fused kernel bodies are the explicit allowlist — a
+Pallas kernel cannot call back into XLA ops).  The seeded-violation
+corpus (tests/analysis_corpus/decode_reimpl.py) pins that each rule
+still fires on every pattern the greps used to catch.
 """
 
-import io
-import re
-import tokenize
 from pathlib import Path
 
 import jax
@@ -203,47 +204,13 @@ class TestSlotRolloutInvariance:
 
 
 # ---------------------------------------------- single-definition guard
-
-def _code_only(path: Path) -> str:
-    """Source with comments and string literals stripped — docstring
-    mentions of the recurrence must not trip the guard."""
-    out = []
-    toks = tokenize.generate_tokens(
-        io.StringIO(path.read_text()).readline
-    )
-    for tok in toks:
-        if tok.type in (tokenize.COMMENT, tokenize.STRING):
-            continue
-        out.append(tok.string)
-    return " ".join(out)
-
-
-# (pattern, files allowed to contain it).  The Pallas kernel bodies and
-# their bit-exact XLA twins keep in-kernel recurrences by necessity —
-# they are the explicit allowlist, everything else must import
-# decoding/core.py.
-_FINGERPRINTS = [
-    # beam selection: top-K over score+logp totals
-    (re.compile(r"\btop_k\s*\("),
-     {"decoding/core.py", "ops/pallas_beam.py"}),
-    # finish update: tok == EOS | tok == PAD
-    (re.compile(r"==\s*EOS_ID\s*\)\s*\|\s*\(\s*\w+\s*==\s*PAD_ID"),
-     {"decoding/core.py", "ops/pallas_beam.py", "ops/pallas_sampler.py"}),
-    # PAD -> EOS feed of finished rows
-    (re.compile(r"==\s*PAD_ID\s*,\s*EOS_ID"),
-     {"decoding/core.py", "ops/pallas_beam.py", "ops/pallas_sampler.py",
-      "training/cst.py"}),  # cst: the PG update's input shift, not a loop
-    # Cache replication at admission (PR 7): the deduped slot layout
-    # stores ONE DecodeCache row per slot — a new `jnp.repeat` fan-out
-    # of cached state is exactly the K x memory regression the dedup
-    # removed.  Allowed: the offline beam expansion (beam.py), the
-    # seq_per_img rollout fan-out (captioner.py), the fused kernels'
-    # twins, the CST reward broadcast (cst.py), and slots.py's
-    # flag-gated legacy replicated layout (serving.dedup_cache=false).
-    (re.compile(r"jnp\s*\.\s*repeat\s*\("),
-     {"decoding/beam.py", "models/captioner.py", "ops/pallas_beam.py",
-      "training/cst.py", "serving/slots.py"}),
-]
+#
+# PR 8 retired the two tokenizer-stripped grep fingerprints that lived
+# here (top_k / finish-update / PAD→EOS feed, and PR 7's jnp.repeat
+# cache-replication guard) in favor of the AST rules CST-DEC-001..004 —
+# same allowlists, reformat/alias-proof matching.  The rules run over
+# the whole package in tests/test_analysis.py; this guard keeps the
+# decode-specific invariant visible next to the decode tests.
 
 
 class TestSingleDefinitionSite:
@@ -257,17 +224,30 @@ class TestSingleDefinitionSite:
             assert mod.decode_step is core.decode_step, mod.__name__
 
     def test_no_second_definition_of_the_recurrence(self):
+        """The AST replacement of the retired greps: zero CST-DEC
+        findings over the package with the kernel-body allowlists in
+        place (removal of an allowlist entry is pinned to fail at the
+        exact file:line in tests/test_analysis.py)."""
+        from cst_captioning_tpu.analysis import CHECKERS
+        from cst_captioning_tpu.analysis.astutil import (
+            PackageIndex,
+            scan_package,
+        )
+        from cst_captioning_tpu.analysis.engine import CheckContext
+
         root = Path(cst_captioning_tpu.__file__).parent
-        offenders = []
-        for path in sorted(root.rglob("*.py")):
-            rel = path.relative_to(root).as_posix()
-            code = _code_only(path)
-            for pat, allowed in _FINGERPRINTS:
-                if pat.search(code) and rel not in allowed:
-                    offenders.append((rel, pat.pattern))
+        mods = [
+            m for m in scan_package(root)
+            if not m.rel.startswith("analysis/")
+        ]
+        ctx = CheckContext(
+            index=PackageIndex(mods), package_root=root, docs_root=None
+        )
+        offenders = CHECKERS["single_site"](mods, ctx)
         assert not offenders, (
             "decode-step recurrence re-implemented outside "
-            f"decoding/core.py: {offenders} — import "
-            "cst_captioning_tpu.decoding.core.decode_step instead "
-            "(kernel bodies: extend the allowlist consciously)"
+            f"decoding/core.py: {[f.render() for f in offenders]} — "
+            "import cst_captioning_tpu.decoding.core.decode_step "
+            "instead (kernel bodies: extend the allowlist in "
+            "analysis/single_site.py consciously)"
         )

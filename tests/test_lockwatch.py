@@ -1,0 +1,170 @@
+"""Dynamic lock-order harness (analysis/lockwatch.py): instrumented
+locks under stub traffic through both serving schedulers must produce
+an ACYCLIC acquisition graph — the runtime twin of the static
+CST-THR-001 rule (ISSUE 8).
+
+The stubs are the same engine/decoder doubles the scheduler behavior
+tests use (test_serving / test_replicas), so the traffic exercises the
+real lock-bearing paths: admission under ``_cond``, tick + harvest,
+metrics updates from inside and outside the lock, replica
+kill/requeue, drain/stop."""
+
+import threading
+import time
+
+from cst_captioning_tpu.analysis.lockwatch import InstrumentedLock, LockWatch
+from cst_captioning_tpu.serving.batcher import ContinuousBatcher
+from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+from test_replicas import _StubEngine as _ReplicaStubEngine
+from test_serving import _StubSlotEngine
+
+
+class TestLockWatchUnit:
+    def test_seeded_inversion_is_detected(self):
+        """Two locks taken in both orders on two threads IS a cycle,
+        even though this run didn't deadlock."""
+        watch = LockWatch()
+        a = InstrumentedLock(watch)
+        b = InstrumentedLock(watch)
+
+        def ab():
+            with a:
+                with b:
+                    pass
+
+        def ba():
+            with b:
+                with a:
+                    pass
+
+        t1, t2 = threading.Thread(target=ab), threading.Thread(target=ba)
+        t1.start(); t1.join()
+        t2.start(); t2.join()
+        cycles = watch.cycles()
+        assert cycles, "inversion not detected"
+        assert {a.label, b.label} <= set(cycles[0])
+        try:
+            watch.assert_acyclic()
+        except AssertionError as e:
+            assert "lock-order inversion" in str(e)
+        else:
+            raise AssertionError("assert_acyclic did not raise")
+
+    def test_consistent_order_is_acyclic(self):
+        watch = LockWatch()
+        a = InstrumentedLock(watch)
+        b = InstrumentedLock(watch)
+        for _ in range(3):
+            with a:
+                with b:
+                    pass
+        assert set(watch.edges) == {(a.label, b.label)}
+        watch.assert_acyclic()
+
+    def test_condition_wait_keeps_stack_truthful(self):
+        """Condition.wait releases/reacquires through the instrumented
+        lock, so a lock acquired AFTER a wait records no edge from the
+        waited-on lock's pre-wait hold."""
+        watch = LockWatch()
+        with watch.patched():
+            cond = threading.Condition()
+        other = InstrumentedLock(watch)
+        done = threading.Event()
+
+        def waiter():
+            with cond:
+                cond.wait(timeout=5.0)
+            with other:
+                done.set()
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)
+        with cond:
+            cond.notify_all()
+        t.join(timeout=5.0)
+        assert done.is_set()
+        watch.assert_acyclic()
+        # the post-wait acquisition must NOT appear nested under cond
+        cond_labels = {
+            a for (a, b) in watch.edges if b == other.label
+        }
+        assert not cond_labels, cond_labels
+
+
+class TestContinuousBatcherLockOrder:
+    def test_stub_traffic_acyclic(self):
+        """Admission, tick, harvest, cache store, deadline bookkeeping
+        and drain through ContinuousBatcher under instrumented locks:
+        the observed acquisition graph has no cycle."""
+        watch = LockWatch()
+        with watch.patched():
+            eng = _StubSlotEngine(S=2)
+            b = ContinuousBatcher(eng)
+        with b:
+            threads = [
+                threading.Thread(
+                    target=lambda i=i: b.submit(
+                        {"steps": 1 + (i % 3), "key": f"k{i}"}
+                    )
+                )
+                for i in range(8)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=10.0)
+        assert b.metrics.requests_served.value == 8
+        # the traffic actually exercised instrumented locks
+        assert sum(watch.acquisitions.values()) > 0
+        assert watch.edges, "no nested acquisitions recorded"
+        watch.assert_acyclic()
+
+
+class TestReplicaSetLockOrder:
+    def test_stub_traffic_with_kill_requeue_acyclic(self):
+        """The full replica lifecycle — admission + routing under the
+        shared cond, double-buffered tick/harvest, kill_replica with
+        in-flight requeue onto the survivor, drain — stays acyclic."""
+        watch = LockWatch()
+        with watch.patched():
+            rs = ReplicaSet(
+                [_ReplicaStubEngine(S=2), _ReplicaStubEngine(S=2)]
+            )
+        results, errors = [], []
+        lock = threading.Lock()
+
+        def go(steps):
+            try:
+                out = rs.submit({"steps": steps})
+                with lock:
+                    results.append(out)
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append(e)
+
+        rs.start()
+        try:
+            threads = [
+                threading.Thread(target=go, args=(2 + (i % 4),))
+                for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.02)          # some work lands on replica 0
+            rs.kill_replica(0)        # drain + requeue path
+            more = [
+                threading.Thread(target=go, args=(1,)) for _ in range(3)
+            ]
+            for t in more:
+                t.start()
+            for t in threads + more:
+                t.join(timeout=15.0)
+        finally:
+            rs.stop()
+        assert not errors, errors
+        assert len(results) == 9
+        assert sum(watch.acquisitions.values()) > 0
+        assert watch.edges, "no nested acquisitions recorded"
+        watch.assert_acyclic()

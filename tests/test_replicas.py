@@ -707,3 +707,34 @@ class TestReplicaServer:
             assert ei.value.code == 503
         finally:
             srv.shutdown()
+
+
+# --------------------------------- PR-8 thread-safety fixes (CST-THR-002)
+
+class TestReplicaStopRace:
+    def test_concurrent_stop_is_safe_and_idempotent(self):
+        """ReplicaSet.stop snapshots worker handles under _cond and
+        clears _threads under _cond after the joins, so racing stop()
+        callers (SIGTERM thread + context exit) can't tear the list or
+        double-fail queued futures."""
+        rs = ReplicaSet([_StubEngine(S=1), _StubEngine(S=1)])
+        rs.start()
+        results, errors, lock = [], [], threading.Lock()
+        _submit_bg(rs, {"steps": 1}, results, errors, lock).join(10.0)
+        stop_errors = []
+
+        def stopper():
+            try:
+                rs.stop()
+            except Exception as e:  # noqa: BLE001
+                stop_errors.append(e)
+
+        threads = [threading.Thread(target=stopper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=15.0)
+        assert not stop_errors
+        assert not rs._running()
+        assert rs._threads == []
+        assert results and not errors

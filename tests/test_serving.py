@@ -1287,3 +1287,71 @@ class TestServerLifecycle:
             assert status == 200
             assert out["caption"] == offline[ds.video_id(9)]
         assert metrics.batches_total.value >= 1  # went through the ladder
+
+
+# --------------------------------- PR-8 thread-safety fixes (CST-THR-002)
+
+class TestThreadSafetyFixes:
+    """Each true-positive the invariant engine surfaced in serving/
+    gets its own pin (ISSUE 8 satellite)."""
+
+    def test_concurrent_stop_is_safe_and_idempotent(self):
+        """_BatcherBase.stop reads/clears the scheduler-thread handle
+        under _cond (the join stays outside — the scheduler needs the
+        cond to exit), so racing stop() callers can't tear the handle."""
+        eng = _StubEngine(max_batch=2)
+        b = MicroBatcher(eng, max_wait_ms=0.0).start()
+        b.submit({"key": "warm"})
+        errors = []
+
+        def stopper():
+            try:
+                b.stop()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=stopper) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=10.0)
+        assert not errors
+        assert not b._running()
+        assert b._thread is None
+        # restartable after a clean stop
+        b.start()
+        assert b.submit({"key": "again"})["caption"] == "stub"
+        b.stop()
+
+    def test_server_draining_flag_is_event_backed(self):
+        """_Server.draining is an Event-backed property (CST-THR-002:
+        handler threads read it, control threads flip it) — begin_drain
+        makes every handler observe it."""
+        from cst_captioning_tpu.serving.server import _Handler, _Server
+
+        srv = _Server(("127.0.0.1", 0), _Handler)
+        try:
+            assert srv.draining is False
+            flips = []
+            t = threading.Thread(
+                target=lambda: (srv._draining_evt.wait(5.0), flips.append(
+                    srv.draining
+                ))
+            )
+            t.start()
+            srv._draining_evt.set()
+            t.join(timeout=10.0)
+            assert flips == [True]
+            # the flag is read-only state: no bare-bool attribute left
+            assert isinstance(
+                type(srv).__dict__.get("draining"), property
+            )
+        finally:
+            srv.server_close()
+
+    def test_pending_declares_single_owner_contract(self):
+        """_Pending's cross-thread handoff contract is declared in
+        source where the analysis pass (and the next reader) finds it."""
+        from cst_captioning_tpu.serving.batcher import _Pending
+
+        assert _Pending._analysis_single_owner is True
