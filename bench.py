@@ -42,6 +42,9 @@ _EOS_BIAS / _BLOCK / _STEPS / _WARM size it), BENCH_SLOT_MEM=0 to skip
 the paired replicated-vs-deduped decode-state memory rows (subprocess
 CPU child; BENCH_SLOT_MEM_SLOTS / _CLIENTS / _REQS / _EOS_BIAS size
 it),
+BENCH_SHARD=0 to skip the paired replicated-vs-model-sharded XE rows
+(subprocess virtual-CPU child; BENCH_SHARD_N / _BATCH / _VOCAB /
+_STEPS size it),
 BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -138,6 +141,21 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
             ):
                 fail(
                     f"{k!r} must be a positive core count, got {v!r}"
+                )
+        # Mesh topology is a machine-readable string by contract
+        # (ISSUE 9): any *_mesh_shape field must look like "2x4" —
+        # axis sizes joined by "x" in declared axis order.  A bool,
+        # None, or free-prose value would make MULTICHIP/shard rows
+        # unreproducible from the record alone.
+        for k, v in rec["extra"].items():
+            if k.endswith("_mesh_shape") and not (
+                isinstance(v, str)
+                and not isinstance(v, bool)
+                and re.fullmatch(r"\d+(x\d+)+", v)
+            ):
+                fail(
+                    f"{k!r} must be a \"2x4\"-style mesh string, "
+                    f"got {v!r}"
                 )
     elif kind == "multichip_partial":
         body = rec.get("dryrun_partial")
@@ -1825,6 +1843,241 @@ def bench_serving_replicas(backend_ok: bool = True):
     return out
 
 
+def _hlo_collective_bytes(hlo: str) -> dict:
+    """Output bytes of every cross-device collective in a compiled HLO,
+    split by op kind.  Counts each op's result shape(s) — the tensor
+    that actually crosses the interconnect boundary — so a replicated
+    layout's grad all-reduces and a TP layout's logit all-gathers are
+    comparable on one axis."""
+    kinds = ("all-gather", "all-reduce", "reduce-scatter",
+             "collective-permute", "all-to-all")
+    dt_bytes = {"f32": 4, "bf16": 2, "f16": 2, "f64": 8,
+                "s32": 4, "u32": 4, "s8": 1, "u8": 1, "pred": 1}
+    shape_pat = re.compile(r"(f32|bf16|f16|f64|s32|u32|s8|u8|pred)\[([\d,]*)\]")
+    out = {k: 0 for k in kinds}
+    count = 0
+    for line in hlo.splitlines():
+        sep = next(
+            (s for k in kinds for s in (f" {k}(", f" {k}-start(")
+             if s in line),
+            None,
+        )
+        if sep is None:
+            continue
+        kind = sep.strip().split("(")[0].removesuffix("-start")
+        # Result shapes precede the op name: "%x = f32[a,b] all-gather("
+        # or "(f32[a], f32[b]) all-reduce-start(".  Split on the op
+        # CALL (" op(") — the op name also appears in result variable
+        # names ("%all-reduce.25 = ..."), which must stay in the head.
+        head = line.split(sep)[0]
+        total = 0
+        for dt, dims in shape_pat.findall(head):
+            elems = int(np.prod([int(d) for d in dims.split(",") if d] or [1]))
+            total += dt_bytes[dt] * elems
+        if total:
+            out[kind] += total
+            count += 1
+    out["total"] = sum(out[k] for k in kinds)
+    out["ops"] = count
+    return out
+
+
+def _bench_shard_impl():
+    """Replicated-vs-model-sharded XE pair on a virtual multi-device CPU
+    mesh (the in-process child of :func:`bench_shard`).
+
+    Same batch, same params, same rng through two meshes over the SAME
+    n devices: pure data parallelism (n x 1) vs a real 2D mesh
+    (n/2 x 2) with the vocab projection + embedding + Adam moments
+    sharded over the model axis per parallel/partition.py and the
+    update step a NamedSharding-in/out jit.  Records steps/s both ways,
+    the per-step HLO collective bytes (grad all-reduce vs logit
+    all-gather trade — docs/PERF.md r12 has the closed-form), the
+    per-device vocab-param bytes (the capacity win that motivates TP),
+    and the first-step loss delta (the PARITY r12 tolerance tier).
+    Virtual-CPU steps/s are not TPU steps/s; the honest
+    ``shard_host_cores``/``*_mesh_shape``/``shard_xla_flags`` fields
+    keep the rows reproducible and caveated from the record alone."""
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.models import model_from_config
+    from cst_captioning_tpu.parallel import (
+        batch_sharding,
+        make_mesh,
+        mesh_shape_str,
+        shard_batch,
+    )
+    from cst_captioning_tpu.training.steps import (
+        create_train_state,
+        make_optimizer,
+        make_xe_train_step,
+    )
+
+    n = len(jax.devices())
+    if n < 4 or n % 2:
+        raise RuntimeError(
+            f"shard pair needs an even >=4 virtual device count, have {n}"
+        )
+    B = int(os.environ.get("BENCH_SHARD_BATCH", "8"))
+    V = int(os.environ.get("BENCH_SHARD_VOCAB", "2048"))
+    steps = int(os.environ.get("BENCH_SHARD_STEPS", "8"))
+    cfg = get_preset("synthetic_smoke")
+    cfg.data.batch_size = B
+    cfg.data.seq_per_img = 2
+    cfg.data.max_seq_len = 10
+    cfg.data.max_frames = 4
+    cfg.data.feature_modalities = ["resnet"]
+    cfg.data.feature_dims = {"resnet": 64}
+    cfg.model.vocab_size = V          # divides every power-of-two axis
+    cfg.model.rnn_size = 64
+    cfg.model.input_encoding_size = 64
+    cfg.model.att_hidden_size = 64
+    cfg.model.drop_prob = 0.0
+    cfg.model.compute_dtype = "float32"
+
+    rng = np.random.RandomState(0)
+    T = cfg.data.max_seq_len
+    batch = {
+        "feats": {"resnet": rng.randn(B, 4, 64).astype(np.float32)},
+        "feat_masks": {"resnet": np.ones((B, 4), np.float32)},
+        "captions": rng.randint(4, V, size=(B, 2, T)).astype(np.int32),
+        "weights": np.ones((B, 2), np.float32),
+        "category": np.zeros((B,), np.int32),
+        "video_idx": np.arange(B, dtype=np.int32),
+    }
+    batch["captions"][:, :, 0] = 1  # BOS
+
+    vocab_leaves = ("word_embed", "logit_w", "logit_b")
+
+    def measure(mesh):
+        model = model_from_config(cfg, mesh=mesh)
+        tx = make_optimizer(cfg.train, 10)
+        state = create_train_state(
+            jax.random.PRNGKey(0), model, tx, batch, mesh=mesh
+        )
+        step = make_xe_train_step(model, mesh=mesh, state_template=state)
+        sh = batch_sharding(mesh)
+        args = (
+            shard_batch(batch["feats"], mesh),
+            shard_batch(batch["feat_masks"], mesh),
+            jax.device_put(jnp.asarray(batch["captions"]), sh),
+            jax.device_put(jnp.asarray(batch["weights"]), sh),
+            None,
+            jax.device_put(jnp.asarray(batch["video_idx"]), sh),
+        )
+        # Per-device bytes of the vocab-sized params: the TP capacity
+        # win, exact from the committed shardings.
+        vocab_dev_bytes = 0
+        for name, leaf in state.params["params"].items():
+            if name in vocab_leaves:
+                vocab_dev_bytes += leaf.addressable_shards[0].data.nbytes
+        coll = _hlo_collective_bytes(
+            step.lower(state, *args, jax.random.PRNGKey(1), 0.0)
+            .compile().as_text()
+        )
+        # Warmup compile, then fixed-seed first step for the parity row.
+        state, m = step(state, *args, jax.random.PRNGKey(1), 0.0)
+        loss0 = float(m["loss"])
+        times = []
+        for i in range(steps):
+            t0 = time.perf_counter()
+            state, m = step(
+                state, *args, jax.random.PRNGKey(2 + i), 0.0
+            )
+            float(m["loss"])
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[len(times) // 2]
+        return {
+            "steps_per_sec": 1.0 / dt,
+            "loss0": loss0,
+            "collective_bytes": coll["total"],
+            "all_gather_bytes": coll["all-gather"],
+            "all_reduce_bytes": coll["all-reduce"],
+            "vocab_param_bytes_per_device": vocab_dev_bytes,
+            "mesh_shape": mesh_shape_str(mesh),
+        }
+
+    rep = measure(make_mesh({"data": n, "model": 1}))
+    tp = measure(make_mesh({"data": n // 2, "model": 2}))
+    out = {
+        "shard_virtual_devices": n,
+        "shard_host_cores": float(os.cpu_count() or 1),
+        # Reproducibility: the exact virtual-platform setup these rows
+        # ran under (ISSUE 9 satellite — MULTICHIP/shard rows must be
+        # reproducible from the record alone).
+        "shard_xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "shard_jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "shard_batch": B,
+        "shard_vocab": V,
+        "shard_replicated_mesh_shape": rep["mesh_shape"],
+        "shard_tp_mesh_shape": tp["mesh_shape"],
+        "shard_replicated_steps_per_sec": round(rep["steps_per_sec"], 3),
+        "shard_tp_steps_per_sec": round(tp["steps_per_sec"], 3),
+        "shard_tp_vs_replicated_ratio": round(
+            tp["steps_per_sec"] / rep["steps_per_sec"], 4
+        ),
+        "shard_replicated_collective_bytes": rep["collective_bytes"],
+        "shard_tp_collective_bytes": tp["collective_bytes"],
+        "shard_replicated_all_gather_bytes": rep["all_gather_bytes"],
+        "shard_tp_all_gather_bytes": tp["all_gather_bytes"],
+        "shard_replicated_all_reduce_bytes": rep["all_reduce_bytes"],
+        "shard_tp_all_reduce_bytes": tp["all_reduce_bytes"],
+        "shard_replicated_vocab_param_bytes": rep[
+            "vocab_param_bytes_per_device"
+        ],
+        "shard_tp_vocab_param_bytes": tp["vocab_param_bytes_per_device"],
+        # PARITY r12: losses live in the relaxed tolerance tier (the
+        # sharded log_softmax reduces in a different order), so the
+        # delta is recorded, not asserted-zero.
+        "shard_loss_delta": abs(rep["loss0"] - tp["loss0"]),
+    }
+    return out
+
+
+def bench_shard(backend_ok: bool = True):
+    """Replicated-vs-model-sharded pair (see :func:`_bench_shard_impl`).
+    Runs inline on a >=4-device host, otherwise re-execs onto a virtual
+    multi-device CPU platform (``BENCH_SHARD_N`` ways, default 4 — the
+    tests/conftest.py recipe) so the pair measures real device-parallel
+    sharding rather than one device pretending."""
+    import subprocess
+
+    if backend_ok:
+        try:
+            if len(jax.devices()) >= 4 and len(jax.devices()) % 2 == 0:
+                return _bench_shard_impl()
+        except Exception:  # noqa: BLE001 — fall through to the child
+            pass
+    env = dict(os.environ)
+    n = int(env.get("BENCH_SHARD_N", "0")) or 4
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SHARD_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"shard pair child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    out = json.loads(lines[-1])
+    out["shard_virtual_cpu"] = True
+    return out
+
+
 def bench_loader():
     """Host batch assembly from the packed feature store at MSR-VTT shape
     (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
@@ -2202,6 +2455,17 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["replicas_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_SHARD", "1") == "1":
+        # Paired replicated-vs-model-sharded XE rows on a >=4-device
+        # mesh (ISSUE 9): inline on multi-device hosts, re-exec'd onto
+        # a virtual CPU platform otherwise — vocab-matmul collective
+        # bytes + steps/s + per-device vocab-param bytes, with honest
+        # *_mesh_shape / *_host_cores / xla-flags provenance fields.
+        try:
+            extra.update(bench_shard(backend_ok=ok))
+        except Exception as e:  # noqa: BLE001
+            extra["shard_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if os.environ.get("BENCH_LOADER", "1") == "1":
         # Host-only bench: runs even when the device backend is down.
         try:
@@ -2269,6 +2533,13 @@ if __name__ == "__main__":
         # (bench_slot_mem).
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_slot_mem_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_SHARD_CHILD") == "1":
+        # Re-exec'd replicated-vs-model-sharded child (bench_shard):
+        # parent forced a virtual multi-device CPU platform; repeat the
+        # config update so a sitecustomize platform pin can't win.
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_shard_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_REPLICA_CHILD") == "1":
         # Re-exec'd replica-sweep child (bench_serving_replicas): the

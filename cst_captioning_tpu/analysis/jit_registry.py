@@ -136,3 +136,42 @@ JIT_SITE_REGISTRY: Dict[str, JitSite] = {
         "per bench invocation"
     ),
 }
+
+
+# Every ``with_sharding_constraint`` site in the package (and every call
+# through ``parallel/partition.py::constrain``), keyed
+# ``<file>::<enclosing qualname>`` — CST-SHD-002 fails the pass on any
+# unregistered site and on stale entries.  The value is reviewer-facing
+# prose: WHAT the pin buys (which all-gather it prevents, which SPMD
+# partitioner cliff it avoids).  A constraint with no story is usually a
+# constraint papering over a placement bug.
+SHARDING_CONSTRAINT_REGISTRY: Dict[str, str] = {
+    "parallel/partition.py::constrain": (
+        "the one raw-constraint helper every boundary pin can route "
+        "through; degrades to identity off-mesh so call sites stay "
+        "unconditional"
+    ),
+    "training/steps.py::make_xe_train_step.train_step.loss_fn": (
+        "pins the (rows, T, V) XE logits rows-over-data x "
+        "vocab-over-model before the loss so XLA keeps the dominant "
+        "vocab matmul sharded instead of all-gathering the logits into "
+        "every device (docs/PERF.md r12 comm arithmetic)"
+    ),
+    "training/cst.py::_pg_update.loss_fn": (
+        "pins the PG logits before log_softmax: without it the SPMD "
+        "partitioner flattens the softmax reductions onto all devices "
+        "and hits the involuntary-full-remat cliff the dryrun tripwire "
+        "fails on (see _pg_update docstring)"
+    ),
+    "training/cst.py::_make_one_graph_step.score": (
+        "replicates the tiny (B*S,) reward-callback operands/result on "
+        "old-shard_map meshes so the device-0 io_callback crossing is a "
+        "plain broadcast, not a full repartition of sharded activations"
+    ),
+    "serving/slots.py::SlotDecoder._build_step.step_once.step_logits": (
+        "model-sharded serving: keeps the (rows, V) decode-step logits "
+        "vocab-over-model through the step so the logit matmul stays "
+        "sharded up to the top-K/argmax instead of all-gathering every "
+        "step (docs/PERF.md r12)"
+    ),
+}

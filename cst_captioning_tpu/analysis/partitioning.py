@@ -1,0 +1,202 @@
+"""CST-SHD: partition-rule and sharding-constraint discipline.
+
+The 2D (data x model) mesh work (ISSUE 9) hangs every placement
+decision off ONE literal table — ``parallel/partition.py``'s
+``PARTITION_RULES`` — and a handful of ``with_sharding_constraint``
+activation pins.  Both rot silently: a new param family falls through
+to an accidental default, a constraint site appears without a recorded
+retrace/propagation story, a renamed tensor leaves a rule matching
+nothing.  Three rules machine-check the contracts (catalogue in
+docs/ANALYSIS.md):
+
+* **CST-SHD-001** — every leaf in ``KNOWN_PARAM_LEAVES`` must match
+  EXACTLY ONE rule regex: an unmatched leaf means a new tensor has no
+  placement decision; a doubly-matched leaf means the table is
+  ambiguous (first-match-wins would hide the conflict).
+* **CST-SHD-002** — every ``with_sharding_constraint`` call site (and
+  every call through the ``partition.constrain`` helper) must be
+  registered in ``analysis/jit_registry.py::
+  SHARDING_CONSTRAINT_REGISTRY`` with a prose justification of what the
+  pin buys (which all-gather it prevents / which partitioner cliff it
+  avoids); stale registry entries are findings too.  pjit/jit sites are
+  already covered by CST-DON-002.
+* **CST-SHD-003** — a rule whose regex matches NO known leaf is stale:
+  the tensor it governed was renamed or removed.
+
+The checker is table-driven off the AST (``ast.literal_eval`` of the
+two module-level assignments), so it runs jax-free like every other
+family, and it applies to ANY scanned module defining both names — the
+corpus seeds violations in a toy table without touching the real one.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Tuple
+
+from cst_captioning_tpu.analysis import jit_registry
+from cst_captioning_tpu.analysis.astutil import ModuleInfo, call_name
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+
+RULES_NAME = "PARTITION_RULES"
+LEAVES_NAME = "KNOWN_PARAM_LEAVES"
+
+# Call names that ARE a sharding constraint: the raw jax API under any
+# import spelling, plus the package's partition.constrain helper.
+_RAW_CONSTRAINT = "with_sharding_constraint"
+_HELPER_NAMES = ("constrain",)
+
+
+def _module_assign(mi: ModuleInfo, name: str) -> Optional[ast.Assign]:
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return node
+    return None
+
+
+def _rule_table(
+    node: ast.Assign,
+) -> Optional[List[Tuple[str, int]]]:
+    """[(regex string, lineno)] from a literal PARTITION_RULES tuple —
+    None when the assignment isn't the expected literal shape."""
+    val = node.value
+    if not isinstance(val, (ast.Tuple, ast.List)):
+        return None
+    out: List[Tuple[str, int]] = []
+    for elt in val.elts:
+        if not (
+            isinstance(elt, (ast.Tuple, ast.List))
+            and elt.elts
+            and isinstance(elt.elts[0], ast.Constant)
+            and isinstance(elt.elts[0].value, str)
+        ):
+            return None
+        out.append((elt.elts[0].value, elt.elts[0].lineno))
+    return out
+
+
+def _leaf_list(node: ast.Assign) -> Optional[List[str]]:
+    try:
+        val = ast.literal_eval(node.value)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(val, (tuple, list)) and all(
+        isinstance(x, str) for x in val
+    ):
+        return list(val)
+    return None
+
+
+def _check_rule_tables(mi: ModuleInfo) -> List[Finding]:
+    rules_node = _module_assign(mi, RULES_NAME)
+    leaves_node = _module_assign(mi, LEAVES_NAME)
+    if rules_node is None or leaves_node is None:
+        return []
+    rules = _rule_table(rules_node)
+    leaves = _leaf_list(leaves_node)
+    out: List[Finding] = []
+    if rules is None or leaves is None:
+        out.append(Finding(
+            "CST-SHD-001", mi.rel,
+            (rules_node if rules is None else leaves_node).lineno,
+            "<module>",
+            f"{RULES_NAME}/{LEAVES_NAME} must be literal tuples the "
+            "jax-free pass can read off the AST",
+        ))
+        return out
+    compiled: List[Tuple[str, int, re.Pattern]] = []
+    for pat, lineno in rules:
+        try:
+            compiled.append((pat, lineno, re.compile(pat)))
+        except re.error as e:
+            out.append(Finding(
+                "CST-SHD-001", mi.rel, lineno, RULES_NAME,
+                f"rule regex {pat!r} does not compile: {e}",
+            ))
+    for leaf in leaves:
+        hits = [pat for pat, _, rx in compiled if rx.search(leaf)]
+        if len(hits) == 1:
+            continue
+        what = (
+            "matches NO partition rule — a new tensor has no placement "
+            "decision; add a rule"
+            if not hits
+            else f"matches {len(hits)} rules {hits} — the table is "
+            "ambiguous; rules must partition the leaves exactly once"
+        )
+        out.append(Finding(
+            "CST-SHD-001", mi.rel, leaves_node.lineno, LEAVES_NAME,
+            f"param leaf {leaf!r} {what}",
+        ))
+    for pat, lineno, rx in compiled:
+        if not any(rx.search(leaf) for leaf in leaves):
+            out.append(Finding(
+                "CST-SHD-003", mi.rel, lineno, RULES_NAME,
+                f"partition rule {pat!r} matches no known param leaf — "
+                "the tensor it governed was renamed or removed; update "
+                "or delete the rule",
+            ))
+    return out
+
+
+def _is_constraint_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1]
+    return last == _RAW_CONSTRAINT or last in _HELPER_NAMES
+
+
+def _check_constraint_sites(
+    mi: ModuleInfo, seen: Dict[str, Tuple[str, int, str]]
+) -> List[Finding]:
+    out: List[Finding] = []
+    flagged = set()
+    for node in ast.walk(mi.tree):
+        if not (isinstance(node, ast.Call) and _is_constraint_call(node)):
+            continue
+        sym = mi.qualname_of(node)
+        key = f"{mi.rel}::{sym}"
+        seen[key] = (mi.rel, node.lineno, sym)
+        if key in jit_registry.SHARDING_CONSTRAINT_REGISTRY:
+            continue
+        if key in flagged:
+            continue
+        flagged.add(key)
+        out.append(Finding(
+            "CST-SHD-002", mi.rel, node.lineno, sym,
+            f"sharding-constraint site `{key}` is not registered — add "
+            "it to analysis/jit_registry.py::"
+            "SHARDING_CONSTRAINT_REGISTRY with what the pin buys "
+            "(which all-gather/partitioner cliff it prevents)",
+        ))
+    return out
+
+
+@register_checker("partitioning")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Dict[str, Tuple[str, int, str]] = {}
+    scanned_rels = set()
+    for mi in modules:
+        scanned_rels.add(mi.rel)
+        out.extend(_check_rule_tables(mi))
+        out.extend(_check_constraint_sites(mi, seen))
+    # Stale registry entries: only judged for files this scan actually
+    # covered (a corpus scan must not flag the real package's entries).
+    for key in sorted(jit_registry.SHARDING_CONSTRAINT_REGISTRY):
+        rel = key.split("::", 1)[0]
+        if rel in scanned_rels and key not in seen:
+            out.append(Finding(
+                "CST-SHD-002", "analysis/jit_registry.py", 1, key,
+                f"stale sharding-constraint registry entry `{key}` "
+                "matches no site — the code moved; update or remove it",
+            ))
+    return out

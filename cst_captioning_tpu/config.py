@@ -285,6 +285,18 @@ class ServingConfig:
     # scheduler (ContinuousBatcher); 0 = one replica per local device;
     # N > len(devices) wraps round-robin onto the same devices.
     replicas: int = 1
+    # Model-sharded serving: ONE logical replica spans this many
+    # devices on a (data=1, model=N) mesh — vocab-sized params shard
+    # per parallel/partition.py, decode-step logits carry a
+    # with_sharding_constraint over the model axis, slot/decode state
+    # stays replicated across the shard group (the data axis is 1).
+    # 1 = today's per-device replica scaling, byte-identical to the
+    # pre-TP engine; > 1 requires replicas == 1 and at least that many
+    # local devices.  Decoded tokens are exact vs model_shards=1: the
+    # column-sharded vocab matmul computes each logit column with the
+    # same reduction order as the replicated layout (docs/PARITY.md
+    # r12).
+    model_shards: int = 1
     # Router policy across replica admission queues: "least_loaded"
     # (most free slots minus queued work wins, round-robin tiebreak) or
     # "round_robin".
@@ -489,6 +501,38 @@ def _preset_msrvtt_serve() -> Config:
     return c
 
 
+def _preset_msrvtt_xe_2d() -> Config:
+    """MSR-VTT XE pretrain on a REAL 2D (data x model) mesh: vocab-sized
+    params + Adam moments shard over a model axis of 2, batch over the
+    remaining devices (parallel/partition.py rules; update steps are
+    NamedSharding-in/out jits).  The 10,496-token vocab divides every
+    power-of-two model axis, so the dominant logit/embedding matmuls
+    actually shard instead of falling back to replication.  The fused
+    Pallas decode kernels step aside on multi-device meshes
+    (model_from_config gate) — docs/PERF.md r12 has the comm-volume
+    arithmetic for when the trade wins."""
+    c = _preset_msrvtt_xe()
+    c.name = "msrvtt_xe_2d"
+    c.train.mesh_shape = {"data": -1, "model": 2}
+    # Vocab padded at build time stays a multiple of 256 (bench shape);
+    # any preset vocab must divide the model axis for the sharding to
+    # engage (shard_params falls back to replication otherwise).
+    return c
+
+
+def _preset_msrvtt_serve_tp() -> Config:
+    """Model-sharded serving: one logical replica spanning 2 devices on
+    a (data=1, model=2) mesh instead of two independent clones — halves
+    the per-device vocab-param footprint, serves bigger decoders than
+    one device holds.  Token-exact vs the replicated engine
+    (docs/PARITY.md r12)."""
+    c = _preset_msrvtt_serve()
+    c.name = "msrvtt_serve_tp2"
+    c.serving.replicas = 1
+    c.serving.model_shards = 2
+    return c
+
+
 def _preset_synthetic_smoke() -> Config:
     """CPU-runnable synthetic tiny config (tests / CI / integration)."""
     c = Config(name="synthetic_smoke")
@@ -530,6 +574,8 @@ PRESETS = {
     "msrvtt_cst_ms_scb": _preset_msrvtt_cst_ms,
     "msrvtt_eval_beam5": _preset_msrvtt_eval,
     "msrvtt_serve_beam5": _preset_msrvtt_serve,
+    "msrvtt_xe_2d": _preset_msrvtt_xe_2d,
+    "msrvtt_serve_tp2": _preset_msrvtt_serve_tp,
     "synthetic_smoke": _preset_synthetic_smoke,
 }
 

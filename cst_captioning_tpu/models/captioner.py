@@ -996,10 +996,24 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
             )
             return False
         if mesh is not None and mesh.devices.size > 1:
+            # A model-sharded vocab makes the decline structural, not
+            # just a missing lowering rule: the fused kernels' online
+            # per-beam top-K streams the FULL vocab tile-by-tile inside
+            # one core's VMEM — under a vocab-over-model layout each
+            # shard would see only V/M columns and the top-K would need
+            # a cross-shard merge the kernel doesn't implement.  A
+            # per-shard shard_map port needs that merge collective; the
+            # dense per-step math shards fine (docs/PERF.md r12).
+            model_ways = mesh.shape.get("model", 1)
+            detail = (
+                f"vocab sharded {model_ways}-way over `model` — the "
+                "in-kernel online top-K has no cross-shard merge"
+                if model_ways > 1
+                else "pallas_call has no SPMD partitioning rule"
+            )
             warn_fused_decline(
                 flag_name,
-                f"{mesh.devices.size}-device mesh — pallas_call has no "
-                "SPMD partitioning rule",
+                f"{mesh.devices.size}-device mesh — {detail}",
             )
             return False
         if m.num_layers != 1:

@@ -26,6 +26,16 @@ from cst_captioning_tpu.parallel.mesh import (  # noqa: F401
     make_mesh,
     mesh_from_config,
 )
+from cst_captioning_tpu.parallel.partition import (  # noqa: F401
+    KNOWN_PARAM_LEAVES,
+    PARTITION_RULES,
+    logits_sharding,
+    match_partition_rules,
+    mesh_shape_str,
+    shard_tree,
+    state_shardings,
+    tree_shardings,
+)
 from cst_captioning_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
     make_placer,

@@ -55,22 +55,46 @@ def shard_map(f, *, mesh, in_specs, out_specs, check_rep=None):
 def make_mesh(
     shape: Dict[str, int], devices: Optional[Sequence] = None
 ) -> Mesh:
+    if not shape:
+        raise ValueError("mesh shape is empty — need at least one axis")
     devices = list(devices if devices is not None else jax.devices())
+    # Deterministic device order across hosts: jax.devices() is id-sorted
+    # on a single process, but an explicit (process_index, id) sort makes
+    # the multi-host mesh layout independent of enumeration quirks — the
+    # same {axis: size} dict must place the same device at the same mesh
+    # coordinate on every host, or collectives deadlock.
+    devices.sort(
+        key=lambda d: (getattr(d, "process_index", 0), getattr(d, "id", 0))
+    )
     n = len(devices)
     sizes = dict(shape)
+    bad = {k: v for k, v in sizes.items() if v != -1 and v < 1}
+    if bad:
+        raise ValueError(
+            f"mesh axes must be positive (or -1 to absorb): {bad}"
+        )
     wild = [k for k, v in sizes.items() if v == -1]
     if len(wild) > 1:
         raise ValueError(f"at most one -1 axis allowed, got {wild}")
     fixed = int(np.prod([v for v in sizes.values() if v != -1]))
     if wild:
         if n % fixed:
+            fixed_axes = {
+                k: v for k, v in sizes.items() if v != -1
+            }
             raise ValueError(
-                f"{n} devices not divisible by fixed axes product {fixed}"
+                f"{n} devices not divisible by fixed axes {fixed_axes} "
+                f"(product {fixed}) — axis {wild[0]!r} cannot absorb "
+                f"{n}/{fixed} ways; pick sizes whose product divides "
+                "the device count"
             )
         sizes[wild[0]] = n // fixed
     total = int(np.prod(list(sizes.values())))
     if total > n:
-        raise ValueError(f"mesh {sizes} needs {total} devices, have {n}")
+        raise ValueError(
+            f"mesh {sizes} needs {total} devices, have {n} — shrink an "
+            "axis or add devices"
+        )
     if total < n:
         log.warning(
             "mesh %s uses %d of %d devices — %d chips idle",

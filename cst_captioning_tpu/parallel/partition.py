@@ -1,0 +1,222 @@
+"""Regex partition rules -> ``PartitionSpec`` pytrees over params AND
+optimizer state (the snippet-[3] ``match_partition_rules`` port).
+
+The rule table below is THE single definition site of how every
+parameter family lands on the 2D (data x model) mesh.  Three contracts
+keep it honest, machine-checked by the CST-SHD analysis family
+(analysis/partitioning.py; catalogue in docs/ANALYSIS.md):
+
+* every known param leaf matches EXACTLY ONE rule — no silent
+  replicated fallthrough for a new tensor, no ambiguous double match
+  (CST-SHD-001);
+* every ``with_sharding_constraint`` site in the package is registered
+  in ``analysis/jit_registry.py::SHARDING_CONSTRAINT_REGISTRY`` with a
+  prose justification (CST-SHD-002);
+* a rule whose regex matches no known leaf is stale (CST-SHD-003).
+
+``KNOWN_PARAM_LEAVES`` is the static mirror of the real param trees —
+tests/test_partition.py pins it against actual ``model.init`` trees for
+every fusion/category configuration, so the AST-level cross-check can
+never drift from the code.
+
+Rules are written as plain literals (regex string, axis-name tuple) so
+the jax-free analysis pass can read them straight off the AST.
+Specs follow the Mesh-TensorFlow named-axis style: vocab-sized tensors
+shard over ``model`` (rows of the embedding, columns of the logit
+head), everything else — LSTM kernels, feature projections, the
+attention MLP, category embedding — is small and replicated.  Optax
+optimizer state needs no second table: Adam's mu/nu mirror the param
+tree leaf-for-leaf, so the SAME regexes match their paths, and scalar
+leaves (step counters) are never partitioned.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# (regex over the flattened leaf path, PartitionSpec axes as a literal
+# tuple).  First element of each spec tuple maps to dim 0, etc.; an
+# empty tuple is full replication.  Exactly-one-match per leaf is the
+# CST-SHD-001 contract — regexes are written mutually exclusive on
+# purpose (no catch-all).
+PARTITION_RULES = (
+    (r"word_embed$", ("model", None)),       # (V, E): vocab rows
+    (r"logit_w$", (None, "model")),          # (H, V): vocab columns
+    (r"logit_b$", ("model",)),               # (V,)
+    (r"lstm\d+_[wb]$", ()),                  # recurrence: replicated
+    (r"proj_[A-Za-z0-9]+_[wb]$", ()),        # feature projections
+    (r"att_(b|v|wf|wh)$", ()),               # Bahdanau attention MLP
+    (r"cat_embed$", ()),                     # category embedding
+)
+
+# Canonical param-leaf names across every model configuration
+# (meanpool/attention fusion, category on/off, both bundled feature
+# modalities, 1-2 LSTM layers).  tests/test_partition.py asserts this
+# list covers — and is covered by — real init trees, so CST-SHD's
+# static cross-check tracks the code by construction.
+KNOWN_PARAM_LEAVES = (
+    "word_embed",
+    "logit_w",
+    "logit_b",
+    "lstm0_w",
+    "lstm0_b",
+    "lstm1_w",
+    "lstm1_b",
+    "proj_resnet_w",
+    "proj_resnet_b",
+    "proj_c3d_w",
+    "proj_c3d_b",
+    "att_b",
+    "att_v",
+    "att_wf",
+    "att_wh",
+    "cat_embed",
+)
+
+
+def compiled_rules(
+    rules: Sequence[Tuple[str, tuple]] = PARTITION_RULES,
+):
+    """[(compiled regex, PartitionSpec)] from the literal table."""
+    return [(re.compile(pat), P(*spec)) for pat, spec in rules]
+
+
+def path_str(path) -> str:
+    """Flattened tree path -> ``a/b/c`` string the rules match against."""
+    return "/".join(
+        getattr(k, "key", getattr(k, "name", str(k))) for k in path
+    )
+
+
+def _is_scalar(leaf) -> bool:
+    shape = getattr(leaf, "shape", None)
+    return shape is None or len(shape) == 0 or int(np.prod(shape)) == 1
+
+
+def spec_for_leaf(name: str, leaf=None, rules=None, strict: bool = True) -> P:
+    """Spec for one leaf path.  Scalars are never partitioned.  With
+    ``strict`` (the default) a leaf matching zero or more than one rule
+    raises — the runtime twin of CST-SHD-001."""
+    if leaf is not None and _is_scalar(leaf):
+        return P()
+    rules = rules if rules is not None else compiled_rules()
+    hits = [(pat.pattern, spec) for pat, spec in rules if pat.search(name)]
+    if len(hits) == 1:
+        return hits[0][1]
+    if not strict:
+        return hits[0][1] if hits else P()
+    if not hits:
+        raise ValueError(
+            f"no partition rule matches param leaf {name!r} — add a rule "
+            "to parallel/partition.py::PARTITION_RULES (and its name to "
+            "KNOWN_PARAM_LEAVES)"
+        )
+    raise ValueError(
+        f"param leaf {name!r} matches {len(hits)} partition rules "
+        f"({[h[0] for h in hits]}) — rules must partition the leaves "
+        "exactly once"
+    )
+
+
+def match_partition_rules(rules, tree, strict: bool = True):
+    """Pytree of ``PartitionSpec`` for ``tree`` per ``rules`` — works on
+    a param dict, an optax optimizer state, or a whole flax TrainState
+    (mu/nu mirror the param tree so the same regexes match; scalar
+    leaves map to ``P()``).  ``rules`` may be the literal table or
+    pre-compiled pairs."""
+    if rules and isinstance(rules[0][0], str):
+        rules = compiled_rules(rules)
+
+    def spec(path, leaf):
+        return spec_for_leaf(path_str(path), leaf, rules, strict=strict)
+
+    return jax.tree_util.tree_map_with_path(spec, tree)
+
+
+def _divisible(leaf, spec: P, mesh: Mesh) -> bool:
+    shape = getattr(leaf, "shape", ())
+    for dim, axis in enumerate(spec):
+        if axis is None:
+            continue
+        if dim >= len(shape) or shape[dim] % mesh.shape[axis] != 0:
+            return False
+    return True
+
+
+def tree_shardings(tree, mesh: Mesh, rules=None, strict: bool = True):
+    """Pytree of ``NamedSharding`` for ``tree`` on ``mesh``.  A leaf
+    whose sharded dim doesn't divide its mesh axis falls back to
+    replication (correctness first — pad the vocab to a multiple of the
+    model axis to get the sharding benefit)."""
+    rules = compiled_rules(rules if rules is not None else PARTITION_RULES)
+
+    def shard(path, leaf):
+        spec = spec_for_leaf(path_str(path), leaf, rules, strict=strict)
+        if not _divisible(leaf, spec, mesh):
+            spec = P()
+        return NamedSharding(mesh, spec)
+
+    return jax.tree_util.tree_map_with_path(shard, tree)
+
+
+def state_shardings(state, mesh: Mesh, rules=None):
+    """``NamedSharding`` pytree for a whole TrainState: rule-matched
+    params AND optimizer moments, replicated scalars/counters — the
+    in/out sharding contract of every update-step jit."""
+    return tree_shardings(state, mesh, rules=rules)
+
+
+def shard_tree(tree, mesh: Mesh, rules=None):
+    """Commit every leaf of ``tree`` to the mesh per the rules (the
+    placement twin of :func:`tree_shardings`)."""
+    sh = tree_shardings(tree, mesh, rules=rules)
+    return jax.tree.map(jax.device_put, tree, sh)
+
+
+def replicated(mesh: Optional[Mesh]) -> Optional[NamedSharding]:
+    return None if mesh is None else NamedSharding(mesh, P())
+
+
+def batch_spec(mesh: Mesh) -> P:
+    return P("data" if mesh.shape.get("data", 1) > 1 else None)
+
+
+def logits_spec(mesh: Mesh, ndim: int = 3) -> P:
+    """Rows-over-data x vocab-over-model spec for an activation whose
+    LAST dim is the vocab: (rows, T, V) training logits or (rows, V)
+    decode-step logits.  Axes of size 1 degrade to ``None`` so the spec
+    is always valid on the mesh at hand."""
+    data = "data" if mesh.shape.get("data", 1) > 1 else None
+    model = "model" if mesh.shape.get("model", 1) > 1 else None
+    return P(*((data,) + (None,) * (ndim - 2) + (model,)))
+
+
+def logits_sharding(
+    mesh: Optional[Mesh], ndim: int = 3
+) -> Optional[NamedSharding]:
+    """``NamedSharding`` for :func:`logits_spec`, or None off-mesh."""
+    if mesh is None:
+        return None
+    return NamedSharding(mesh, logits_spec(mesh, ndim))
+
+
+def constrain(x, sharding: Optional[NamedSharding]):
+    """``with_sharding_constraint`` that degrades to identity off-mesh —
+    the one helper every activation-boundary pin routes through, so the
+    CST-SHD-002 registry has a single raw-constraint site to anchor."""
+    if sharding is None:
+        return x
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
+def mesh_shape_str(mesh: Optional[Mesh]) -> str:
+    """``"2x4"``-style string (axis order as declared) — the
+    ``*_mesh_shape`` bench-record format validate_record enforces."""
+    if mesh is None:
+        return "1x1"
+    return "x".join(str(mesh.shape[a]) for a in mesh.axis_names)

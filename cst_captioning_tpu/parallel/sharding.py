@@ -8,23 +8,24 @@ collective over ``model``) on the ICI mesh.
 
 from __future__ import annotations
 
-import re
-
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from cst_captioning_tpu.parallel.partition import (
+    PARTITION_RULES,
+    compiled_rules,
+)
 
 # Parameter-name -> spec rules for the model axis.  The only tensors worth
 # sharding in an LSTM captioner are vocab-sized (V ~ 10-20k):
 #   word_embed (V, E) — rows sharded over model
 #   logit_w    (H, V) — columns sharded over model
 # Everything else (LSTM kernels, projections, attention MLP) is tiny and
-# replicated.  Rules are regexes over the flattened param path.
-DEFAULT_PARAM_RULES = (
-    (re.compile(r"word_embed$"), P("model", None)),
-    (re.compile(r"logit_w$"), P(None, "model")),
-    (re.compile(r"logit_b$"), P("model")),
-)
+# replicated.  The table itself lives in ``parallel/partition.py``
+# (PARTITION_RULES — the CST-SHD-checked single definition site); this
+# module keeps the compiled first-match view older call sites use.
+DEFAULT_PARAM_RULES = tuple(compiled_rules(PARTITION_RULES))
 
 
 def param_spec(path: str, rules=DEFAULT_PARAM_RULES) -> P:

@@ -104,6 +104,41 @@ class InferenceEngine:
         self.vocab = self._resolve_vocab(vocab)
         if cfg.model.vocab_size == 0:
             cfg.model.vocab_size = len(self.vocab)
+        # Model-sharded engine (serving.model_shards > 1): ONE logical
+        # replica spans a (data=1, model=N) mesh — vocab-sized params
+        # shard per parallel/partition.py, decode-step logits carry a
+        # model-axis constraint in the slot loop, slot/decode state is
+        # replicated across the shard group (data axis is 1, so
+        # data-sharding degenerates to replication).  model_shards == 1
+        # leaves every code path byte-identical to the pre-TP engine.
+        self.tp_mesh = None
+        model_shards = int(getattr(sv, "model_shards", 1) or 1)
+        if model_shards > 1:
+            if mesh is not None:
+                raise ValueError(
+                    "pass either an explicit mesh or "
+                    "serving.model_shards > 1, not both"
+                )
+            if sv.replicas != 1:
+                raise ValueError(
+                    f"serving.model_shards={model_shards} spans devices "
+                    f"itself — it requires replicas=1 (got "
+                    f"{sv.replicas}); replica x shard grids are a "
+                    "multi-host concern (ROADMAP)"
+                )
+            devs = jax.devices()
+            if len(devs) < model_shards:
+                raise ValueError(
+                    f"serving.model_shards={model_shards} needs that "
+                    f"many devices, have {len(devs)}"
+                )
+            from cst_captioning_tpu.parallel import make_mesh
+
+            self.tp_mesh = make_mesh(
+                {"data": 1, "model": model_shards},
+                devices=devs[:model_shards],
+            )
+            mesh = self.tp_mesh
         self.model: CaptionModel = model_from_config(cfg, mesh=mesh)
         if params is None:
             if checkpoint:
@@ -116,6 +151,13 @@ class InferenceEngine:
                     "InferenceEngine needs `params`, a `checkpoint` path, "
                     "or random_init=True"
                 )
+        if self.tp_mesh is not None:
+            from cst_captioning_tpu.parallel import shard_params
+
+            # Rule-table placement (vocab tensors over `model`); a vocab
+            # that doesn't divide the axis falls back to replication per
+            # tensor — correctness first, pad the vocab for the benefit.
+            params = shard_params(params, self.tp_mesh)
         self.params = params
         self.decode_mode = sv.decode_mode
         if self.decode_mode not in ("beam", "greedy"):
@@ -657,6 +699,12 @@ class InferenceEngine:
         and slot loop at construction ("one warm engine per device")."""
         import copy
 
+        if self.tp_mesh is not None:
+            raise ValueError(
+                "a model-sharded engine (serving.model_shards > 1) spans "
+                "its device group and cannot be cloned per-device — "
+                "replica scaling requires model_shards=1"
+            )
         eng = InferenceEngine(
             copy.deepcopy(self.cfg),
             params=jax.device_put(self.params, device),
@@ -699,4 +747,13 @@ class InferenceEngine:
             "max_frames": self.cfg.data.max_frames,
             "vocab_size": len(self.vocab),
             "backend": jax.default_backend(),
+            # 1x2-style mesh string when model-sharded, "1x1" otherwise
+            # (the same *_mesh_shape format bench records use).
+            "mesh_shape": (
+                "1x1" if self.tp_mesh is None
+                else "x".join(
+                    str(self.tp_mesh.shape[a])
+                    for a in self.tp_mesh.axis_names
+                )
+            ),
         }

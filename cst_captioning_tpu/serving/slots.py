@@ -317,11 +317,36 @@ class SlotDecoder:
         # Replica engines pin their slot matrix to their device so the
         # first tick doesn't silently run on the default device.
         dev = getattr(self.engine, "device", None)
-        return st if dev is None else jax.device_put(st, dev)
+        if dev is not None:
+            return jax.device_put(st, dev)
+        # Model-sharded engines: slot state is activation-shaped, so it
+        # carries the data-axis sharding — which on the (data=1,
+        # model=N) serving mesh degenerates to replication across the
+        # shard group.  Committing it explicitly keeps the first tick
+        # from running single-device against mesh-sharded params.
+        tp = getattr(self.engine, "tp_mesh", None)
+        if tp is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(
+                st, NamedSharding(tp, PartitionSpec())
+            )
+        return st
 
     def _build_step(self) -> None:
         model, K, dedup = self.model, self.K, self.dedup
         mode = "greedy" if self.greedy else "beam"
+        # Model-sharded engine: pin the (rows, V) decode-step logits
+        # vocab-over-model so XLA keeps the logit matmul sharded through
+        # the step instead of all-gathering before the top-K/argmax —
+        # the serving twin of the training-side logits constraint
+        # (parallel/partition.py::logits_spec, docs/PERF.md r12).
+        tp_logits = None
+        tp = getattr(self.engine, "tp_mesh", None)
+        if tp is not None and tp.shape.get("model", 1) > 1:
+            from cst_captioning_tpu.parallel import partition
+
+            tp_logits = partition.logits_sharding(tp, ndim=2)
 
         def step_once(params, st: SlotState) -> SlotState:
             # The per-step recurrence is the unified decode core
@@ -338,10 +363,15 @@ class SlotDecoder:
                     cache = jax.tree.map(
                         lambda x: x[row_slot], cache
                     )
-                return model.apply(
+                new_state, logits = model.apply(
                     params, state, cache, tokens,
                     method="decode_logits",
                 )
+                if tp_logits is not None:
+                    logits = jax.lax.with_sharding_constraint(
+                        logits, tp_logits
+                    )
+                return new_state, logits
 
             core = decode_step(step_logits, st.core, mode=mode)
             return SlotState(core=core, cache=st.cache)
@@ -938,7 +968,7 @@ class _ParityEngine:
 
     def __init__(
         self, ctx, *, mode: str, num_slots: int, block: int,
-        dedup: bool = True, bank_min: int = 0,
+        dedup: bool = True, bank_min: int = 0, model_shards: int = 1,
     ):
         from types import SimpleNamespace
 
@@ -947,6 +977,28 @@ class _ParityEngine:
         self.decode_mode = mode
         self.max_batch = num_slots
         self.device = None
+        # Model-sharded parity variant: vocab params over a (1, N) mesh
+        # exactly like the real engine's serving.model_shards path, so
+        # the shared harness pins TP decode token-exact vs every other
+        # backend through identical inputs.
+        self.tp_mesh = None
+        if model_shards > 1:
+            import jax as _jax
+
+            from cst_captioning_tpu.parallel import make_mesh, shard_params
+
+            if len(_jax.devices()) < model_shards:
+                _log.info(
+                    "parity engine: %d devices < model_shards=%d — "
+                    "running the replicated layout",
+                    len(_jax.devices()), model_shards,
+                )
+            else:
+                self.tp_mesh = make_mesh(
+                    {"data": 1, "model": model_shards},
+                    devices=_jax.devices()[:model_shards],
+                )
+                self.params = shard_params(self.params, self.tp_mesh)
         self._feats, self._masks, self._cat = (
             ctx.feats, ctx.masks, ctx.category,
         )
@@ -984,16 +1036,18 @@ class _ParityEngine:
         return 0
 
 
-def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0):
+def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0,
+                 model_shards: int = 1):
     """Decode every ctx row through a small slot matrix with staggered
     admissions (slots hold rows at different decode depths), then map
     harvests back to row order.  ``dedup`` selects the per-slot vs the
     legacy replicated cache layout; ``bank_min`` > 0 exercises the
-    elastic bank ladder mid-traffic."""
+    elastic bank ladder mid-traffic; ``model_shards`` > 1 runs the
+    model-sharded (data=1, model=N) engine layout."""
     B = next(iter(ctx.feats.values())).shape[0]
     eng = _ParityEngine(
         ctx, mode=mode, num_slots=max(2, B // 2), block=1,
-        dedup=dedup, bank_min=bank_min,
+        dedup=dedup, bank_min=bank_min, model_shards=model_shards,
     )
     dec = SlotDecoder(eng)
     got_tok: Dict[int, np.ndarray] = {}
@@ -1045,6 +1099,20 @@ register_backend(
 register_backend(
     "slot_decoder_beam_elastic",
     lambda ctx: _slot_runner(ctx, "beam", bank_min=2),
+    kind="beam",
+    ref="scan_beam",
+)
+# Model-sharded variant (serving.model_shards): vocab params + decode
+# logits over a 2-way model axis; the column-sharded logit matmul keeps
+# every column's reduction order, so tokens AND scores must match the
+# replicated layout exactly (the docs/PARITY.md r12 serving contract).
+# On a 1-device host the _ParityEngine degrades to the replicated
+# layout with a log line (device counting at import would force backend
+# init, which the bench probe must control) — tier-1's virtual 8-CPU
+# platform always runs the real sharded path.
+register_backend(
+    "slot_decoder_beam_tp2",
+    lambda ctx: _slot_runner(ctx, "beam", model_shards=2),
     kind="beam",
     ref="scan_beam",
 )

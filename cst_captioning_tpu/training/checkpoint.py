@@ -13,6 +13,7 @@ never needs to know the previous stage's optimizer structure.
 from __future__ import annotations
 
 import json
+import logging
 import os
 from typing import Any, Dict, Optional
 
@@ -78,9 +79,55 @@ def _abstract(x):
     return jax.ShapeDtypeStruct(x.shape, x.dtype)
 
 
+def sharding_metadata(params) -> Dict[str, Any]:
+    """Machine-readable record of HOW a param tree was sharded at save
+    time: the mesh shape (``"2x4"``-style, matching the bench
+    ``*_mesh_shape`` contract), axis names, and per-leaf PartitionSpec
+    strings.  Restore does NOT need it (the template's shardings drive
+    the reshard) — it exists so a checkpoint names the topology it came
+    from, making cross-topology loads auditable from the sidecar alone.
+    """
+    mesh = None
+    specs: Dict[str, str] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        name = "/".join(
+            getattr(k, "key", getattr(k, "name", str(k))) for k in path
+        )
+        sh = getattr(leaf, "sharding", None)
+        if isinstance(sh, NamedSharding):
+            mesh = mesh or sh.mesh
+            specs[name] = str(sh.spec)
+        else:
+            specs[name] = "unsharded"
+    meta: Dict[str, Any] = {"specs": specs}
+    if mesh is not None:
+        meta["mesh_shape"] = "x".join(
+            str(mesh.shape[a]) for a in mesh.axis_names
+        )
+        meta["mesh_axes"] = list(mesh.axis_names)
+    else:
+        meta["mesh_shape"] = "1x1"
+        meta["mesh_axes"] = []
+    return meta
+
+
+def saved_sharding(path: str) -> Dict[str, Any]:
+    """The sharding metadata a checkpoint was saved with ({} for
+    checkpoints from before the sidecar carried it)."""
+    info = load_infos(path)
+    sh = info.get("sharding", {})
+    return sh if isinstance(sh, dict) else {}
+
+
 def save_checkpoint(path: str, state, extra: Optional[Dict[str, Any]] = None
                     ) -> None:
-    """Save a TrainState: params + (opt_state, step) + json sidecar."""
+    """Save a TrainState: params + (opt_state, step) + json sidecar.
+
+    The sidecar always records the save-time mesh/spec metadata
+    (:func:`sharding_metadata`) under ``"sharding"`` — restore onto a
+    DIFFERENT topology is supported (the restore template's shardings
+    drive an orbax reshard; tests/test_partition.py pins the 1x1 ->
+    {2x1, 1x2, 2x2} round trips bit-identical)."""
     path = _abs(path)
     ckptr = ocp.StandardCheckpointer()
     ckptr.save(
@@ -97,9 +144,11 @@ def save_checkpoint(path: str, state, extra: Optional[Dict[str, Any]] = None
     # Orbax coordinates the array writes across processes; the json
     # sidecar has no such coordination — only rank 0 writes it, or
     # multi-host runs on a shared filesystem race on the same file.
-    if extra is not None and jax.process_index() == 0:
+    if jax.process_index() == 0:
+        infos = dict(extra) if extra is not None else {}
+        infos.setdefault("sharding", sharding_metadata(state.params))
         with open(os.path.join(path, "infos.json"), "w") as f:
-            json.dump(extra, f, indent=2, default=str)
+            json.dump(infos, f, indent=2, default=str)
 
 
 def load_infos(path: str) -> Dict[str, Any]:
@@ -110,9 +159,35 @@ def load_infos(path: str) -> Dict[str, Any]:
         return json.load(f)
 
 
+_log = logging.getLogger("cst_captioning_tpu.checkpoint")
+
+
+def _log_reshard(path: str, state) -> None:
+    """Cross-topology load visibility: when the checkpoint's recorded
+    mesh differs from the restore template's, say so — the restore
+    itself is a plain orbax reshard (template shardings win), but a
+    silent topology change is worth one log line in the run record."""
+    saved = saved_sharding(path).get("mesh_shape")
+    if not saved:
+        return
+    now = sharding_metadata(state.params).get("mesh_shape")
+    if now != saved:
+        _log.info(
+            "checkpoint %s was saved on a %s mesh; resharding onto %s "
+            "(template shardings drive the reshard)",
+            path, saved, now,
+        )
+
+
 def restore_checkpoint(path: str, state):
-    """Full resume: params + optimizer + step into ``state``'s structure."""
+    """Full resume: params + optimizer + step into ``state``'s structure.
+
+    Cross-topology by construction: every leaf restores to the
+    TEMPLATE's sharding (``_abstract`` carries it), so a checkpoint
+    saved on one mesh loads onto any other whose leaf shapes match —
+    orbax reshards during the read."""
     path = _abs(path)
+    _log_reshard(path, state)
     ckptr = ocp.StandardCheckpointer()
     params = ckptr.restore(
         os.path.join(path, "params"),

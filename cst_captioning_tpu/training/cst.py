@@ -195,7 +195,7 @@ def _pg_update(state, feats, feat_masks, category, S, tokens, mask,
 
 
 def make_cst_train_step(
-    model: CaptionModel, cfg, train_ds, mesh=None
+    model: CaptionModel, cfg, train_ds, mesh=None, state_template=None
 ) -> Callable:
     """Build the CST step.  Same signature as the XE step (``trainer.py``
     dispatch): ``(state, feats, feat_masks, captions, weights, category,
@@ -212,7 +212,9 @@ def make_cst_train_step(
         from cst_captioning_tpu.training.steps import make_xe_train_step
 
         log.info("cst_use_gt: dispatching CST_GT_None to the WXE step")
-        return make_xe_train_step(model)
+        return make_xe_train_step(
+            model, mesh=mesh, state_template=state_template
+        )
     # Validate BEFORE the io_callback early return: a typo'd layout must
     # fail on every backend, not only when the config first reaches a
     # runtime without host callbacks.
@@ -261,7 +263,9 @@ def make_cst_train_step(
                 "layouts apply only to backends without host callbacks)",
                 layout,
             )
-        return _make_one_graph_step(model, cfg, scorer, mesh=mesh)
+        return _make_one_graph_step(
+            model, cfg, scorer, mesh=mesh, state_template=state_template
+        )
     use_pipeline = layout == "pipeline" or (
         layout == "auto"
         and dispatch_latency_ms() > _CHUNK_MAX_DISPATCH_MS
@@ -283,7 +287,9 @@ def make_cst_train_step(
 
 # ------------------------------------------------------- one-graph variant
 
-def _make_one_graph_step(model, cfg, scorer, mesh=None) -> Callable:
+def _make_one_graph_step(
+    model, cfg, scorer, mesh=None, state_template=None
+) -> Callable:
     S, baseline_kind = _validate(cfg)
     temperature = cfg.train.sample_temperature
     max_len = cfg.data.max_seq_len
@@ -301,17 +307,13 @@ def _make_one_graph_step(model, cfg, scorer, mesh=None) -> Callable:
 
     pg_logits_sharding = None
     if mesh is not None:
-        from jax.sharding import NamedSharding
-        from jax.sharding import PartitionSpec as P
+        from cst_captioning_tpu.parallel import partition
 
-        pg_logits_sharding = NamedSharding(
-            mesh,
-            P(
-                "data",
-                None,
-                "model" if mesh.shape.get("model", 1) > 1 else None,
-            ),
-        )
+        # Rows-over-data x vocab-over-model (partition.logits_spec, the
+        # single definition site of the boundary spec): keeps the PG
+        # log_softmax on the sharded logits instead of the involuntary-
+        # full-remat cliff (see _pg_update docstring).
+        pg_logits_sharding = partition.logits_sharding(mesh, ndim=3)
 
     if (
         mesh is not None
@@ -448,8 +450,16 @@ def _make_one_graph_step(model, cfg, scorer, mesh=None) -> Callable:
 
     # ss_prob stays a traced (unused) arg — marking it static would
     # recompile the whole rollout+backward graph whenever a scheduled-
-    # sampling config ticks its probability.
-    return jax.jit(train_step, donate_argnums=(0,))
+    # sampling config ticks its probability.  On a mesh the jit is
+    # NamedSharding-in/out (state per the partition rules, six batch
+    # args over data, rng + ss_prob replicated) with donation kept.
+    from cst_captioning_tpu.training.steps import sharded_step_kwargs
+
+    return jax.jit(
+        train_step,
+        donate_argnums=(0,),
+        **sharded_step_kwargs(mesh, state_template, 6, 2),
+    )
 
 
 # ----------------------------------------------------------- split variant

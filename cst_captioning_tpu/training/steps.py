@@ -146,27 +146,23 @@ def create_train_state(
     if mesh is None:
         return TrainState.create(apply_fn=model.apply, params=params, tx=tx)
 
-    from jax.sharding import NamedSharding, PartitionSpec
-
+    from cst_captioning_tpu.parallel import partition
     from cst_captioning_tpu.parallel.sharding import shard_params
 
     params = shard_params(params, mesh)
     state = TrainState.create(apply_fn=model.apply, params=params, tx=tx)
-    # Adam moments inherit each param's sharding (zeros_like of sharded
-    # params), but optax's scalar counters are created on the default
-    # device; replicate them over the mesh so every state leaf has a
-    # consistent committed placement (checkpoint restore preserves leaf
-    # shardings — mixed placements would clash after resume).
-    rep = NamedSharding(mesh, PartitionSpec())
-
-    def place(x):
-        if isinstance(x, jax.Array) and not isinstance(
-            x.sharding, NamedSharding
-        ):
-            return jax.device_put(x, rep)
-        return x
-
-    return state.replace(opt_state=jax.tree.map(place, state.opt_state))
+    # Optimizer state is placed by the SAME rule table as the params
+    # (partition.match_partition_rules port: Adam's mu/nu mirror the
+    # param tree so the regexes match their paths; optax's scalar
+    # counters replicate).  zeros_like of sharded params already lands
+    # the moments right — the explicit placement makes it a CHECKED
+    # contract instead of an inherited accident, and commits the stray
+    # default-device counters so every state leaf has a consistent
+    # placement (checkpoint restore preserves leaf shardings — mixed
+    # placements would clash after resume).
+    return state.replace(
+        opt_state=partition.shard_tree(state.opt_state, mesh)
+    )
 
 
 def _flatten_batch(captions, weights):
@@ -182,8 +178,33 @@ def _flatten_batch(captions, weights):
     return caps, w, S
 
 
+def sharded_step_kwargs(mesh, state_template, n_batch_args: int,
+                        n_extra_args: int = 1):
+    """``in_shardings``/``out_shardings`` for an update-step jit:
+    TrainState in/out per the partition rules, batch args over ``data``,
+    trailing extras (rng, traced knobs) replicated, metrics replicated.
+    Returns {} off-mesh so call sites stay unconditional."""
+    if mesh is None or state_template is None:
+        return {}
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from cst_captioning_tpu.parallel import partition
+
+    state_sh = partition.state_shardings(state_template, mesh)
+    batch = NamedSharding(mesh, partition.batch_spec(mesh))
+    rep = NamedSharding(mesh, P())
+    return dict(
+        in_shardings=(
+            (state_sh,) + (batch,) * n_batch_args + (rep,) * n_extra_args
+        ),
+        out_shardings=(state_sh, rep),
+    )
+
+
 def make_xe_train_step(
     model: CaptionModel,
+    mesh=None,
+    state_template=None,
 ) -> Callable:
     """XE/WXE train step. WXE == XE with non-uniform ``weights`` (the loader
     supplies consensus weights; ones for plain XE), reference train_mode
@@ -193,7 +214,19 @@ def make_xe_train_step(
     uniformly): ``(state, feats, feat_masks, captions(B,S,L), weights(B,S),
     category(B,)|None, video_idx(B,), rng, ss_prob) -> (state, metrics)``;
     ``video_idx`` is unused here (the CST step needs it for reward refs).
+
+    With a ``mesh`` + ``state_template`` the jit becomes NamedSharding-
+    in/out: state per the partition rules (vocab tensors + Adam moments
+    over ``model``), batch args over ``data``, and the (rows, T, V)
+    logits pinned rows-over-data x vocab-over-model before the loss so
+    XLA keeps the dominant vocab matmul sharded instead of all-gathering
+    the logits early.  Donation is preserved either way.
     """
+    logits_sharding = None
+    if mesh is not None:
+        from cst_captioning_tpu.parallel import partition
+
+        logits_sharding = partition.logits_sharding(mesh, ndim=3)
 
     def train_step(state, feats, feat_masks, captions, weights, category,
                    video_idx, rng, ss_prob):
@@ -215,6 +248,10 @@ def make_xe_train_step(
                 rngs={"dropout": rng_drop},
                 repeat=S,
             )
+            if logits_sharding is not None:
+                logits = jax.lax.with_sharding_constraint(
+                    logits, logits_sharding
+                )
             return weighted_cross_entropy(logits, targets, tmask, w)
 
         loss, grads = jax.value_and_grad(loss_fn)(state.params)
@@ -225,7 +262,14 @@ def make_xe_train_step(
     # ss_prob is static so the model's statically-zero scheduled-sampling
     # guard applies (it changes a handful of times per run — one recompile
     # per distinct value, reference schedule steps every 5 epochs).
-    return jax.jit(train_step, donate_argnums=(0,), static_argnums=(8,))
+    # Six batch-sharded args (feats..video_idx), one replicated (rng);
+    # ss_prob is static so it takes no sharding slot.
+    return jax.jit(
+        train_step,
+        donate_argnums=(0,),
+        static_argnums=(8,),
+        **sharded_step_kwargs(mesh, state_template, 6, 1),
+    )
 
 
 def make_greedy_sample_fn(model: CaptionModel, max_len: int) -> Callable:

@@ -268,14 +268,22 @@ class Trainer:
         )
 
     def _build_steps(self) -> None:
+        # On a mesh the update-step jits are NamedSharding-in/out: the
+        # TrainState contract comes from the partition rules (vocab
+        # tensors + optimizer moments over `model`, everything else
+        # replicated), batches shard over `data`.  self.state exists by
+        # the time steps are built, so it is the sharding template.
         mode = self.cfg.train.train_mode
         if mode in ("xe", "wxe"):
-            self._train_step = make_xe_train_step(self.model)
+            self._train_step = make_xe_train_step(
+                self.model, mesh=self.mesh, state_template=self.state
+            )
         elif mode == "cst":
             from cst_captioning_tpu.training.cst import make_cst_train_step
 
             self._train_step = make_cst_train_step(
-                self.model, self.cfg, self.train_ds, mesh=self.mesh
+                self.model, self.cfg, self.train_ds, mesh=self.mesh,
+                state_template=self.state,
             )
         else:
             raise ValueError(f"unknown train_mode {mode!r}")

@@ -1,0 +1,28 @@
+# corpus-rules: partitioning
+"""Seeded CST-SHD violations against a toy rule table: a leaf matched
+by two rules AND a leaf matched by none (both anchor CST-SHD-001 at the
+KNOWN_PARAM_LEAVES assignment), a stale rule whose regex matches no
+leaf (CST-SHD-003 at the rule's own line), and an unregistered
+``with_sharding_constraint`` call (CST-SHD-002).  The negative cases —
+``word_proj`` matching exactly one rule, the registered-looking helper
+name used as a plain attribute — must NOT fire."""
+
+import jax
+
+PARTITION_RULES = (
+    (r"word_embed$", ("model", None)),
+    (r"embed$", ()),
+    (r"word_proj$", (None, "model")),
+    (r"ghost_param$", ("model",)),  # expect: CST-SHD-003
+)
+
+KNOWN_PARAM_LEAVES = ("word_embed", "logit_w", "word_proj")  # expect: CST-SHD-001
+
+
+def unregistered_constraint(x, sharding):
+    return jax.lax.with_sharding_constraint(x, sharding)  # expect: CST-SHD-002
+
+
+def negative_not_a_constraint(table):
+    # attribute access / unrelated names must not trip the site scan
+    return table.constraints

@@ -43,6 +43,7 @@ _FAMILY_OF_PREFIX = {
     "CST-DEC": "single_site",
     "CST-DON": "donation",
     "CST-MET": "metrics_registry",
+    "CST-SHD": "partitioning",
 }
 
 
@@ -116,6 +117,32 @@ class TestPackageClean:
         assert ("training/steps.py", "make_xe_train_step.train_step") in traced.roots
         assert ("decoding/core.py", "decode_step") in traced.static
         assert ("decoding/core.py", "decode_step") not in traced.roots
+
+    def test_partition_pass_sees_rules_and_constraint_sites(self):
+        """Vacuous-green guard for CST-SHD: the checker must actually
+        find the real rule table and every known constraint site."""
+        from cst_captioning_tpu.analysis import partitioning as sp
+
+        mods = [
+            m for m in scan_package(PACKAGE_ROOT)
+            if not m.rel.startswith("analysis/")
+        ]
+        mi = next(m for m in mods if m.rel == "parallel/partition.py")
+        rules = sp._rule_table(sp._module_assign(mi, sp.RULES_NAME))
+        leaves = sp._leaf_list(sp._module_assign(mi, sp.LEAVES_NAME))
+        assert rules and len(rules) >= 5
+        assert leaves and "word_embed" in leaves
+        seen = {}
+        for m in mods:
+            sp._check_constraint_sites(m, seen)
+        for key in (
+            "parallel/partition.py::constrain",
+            "training/steps.py::make_xe_train_step.train_step.loss_fn",
+            "training/cst.py::_pg_update.loss_fn",
+            "serving/slots.py::SlotDecoder._build_step"
+            ".step_once.step_logits",
+        ):
+            assert key in seen, f"constraint site {key} not discovered"
 
 
 # ------------------------------------------------------------- the corpus

@@ -144,6 +144,26 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="bool-typed"):
             validate_record(rec)
 
+    def test_mesh_shape_string_passes(self):
+        """*_mesh_shape fields carry the topology a row ran on (ISSUE
+        9): a "2x4"-style string in declared axis order."""
+        rec = good_bench()
+        rec["extra"].update({
+            "shard_replicated_mesh_shape": "4x1",
+            "shard_tp_mesh_shape": "2x2",
+            "dryrun_mesh_shape": "2x2x2",
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize(
+        "bad", [True, False, None, 8, "8", "2 x 4", "data2model4", ""]
+    )
+    def test_mesh_shape_rejects_non_topology_values(self, bad):
+        rec = good_bench()
+        rec["extra"]["shard_tp_mesh_shape"] = bad
+        with pytest.raises(ValueError, match="mesh"):
+            validate_record(rec)
+
     def test_non_dict_extra_fails(self):
         rec = good_bench()
         rec["extra"] = [1, 2]

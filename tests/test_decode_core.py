@@ -233,7 +233,12 @@ class TestSingleDefinitionSite:
             PackageIndex,
             scan_package,
         )
-        from cst_captioning_tpu.analysis.engine import CheckContext
+        from cst_captioning_tpu.analysis.engine import (
+            CheckContext,
+            _load_checkers,
+        )
+
+        _load_checkers()  # registry fills lazily; don't rely on test order
 
         root = Path(cst_captioning_tpu.__file__).parent
         mods = [
