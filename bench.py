@@ -44,7 +44,9 @@ CPU child; BENCH_SLOT_MEM_SLOTS / _CLIENTS / _REQS / _EOS_BIAS size
 it),
 BENCH_SHARD=0 to skip the paired replicated-vs-model-sharded XE rows
 (subprocess virtual-CPU child; BENCH_SHARD_N / _BATCH / _VOCAB /
-_STEPS size it),
+_STEPS size it), BENCH_TRACE=0 to skip the paired tracing-on/off
+serving rows (subprocess CPU child; BENCH_TRACE_REQS / _CLIENTS /
+_REPS size it),
 BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -131,6 +133,15 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
             if k.endswith("_bytes") and not _is_number(v):
                 fail(
                     f"{k!r} must be a numeric byte count, got {v!r}"
+                )
+        # Tracing-overhead pairing (ISSUE 10): every trace_overhead_*
+        # field is a measurement by contract — the paired on/off rows
+        # are only comparable if both sides are real numbers (a bool,
+        # None, or prose value means one side never ran).
+        for k, v in rec["extra"].items():
+            if k.startswith("trace_overhead_") and not _is_number(v):
+                fail(
+                    f"{k!r} must be a real number, got {v!r}"
                 )
         # CPU-host caveats are machine-readable, not prose: any
         # *_host_cores field (cst_pipe_, serving_replicas_, cst_slot_,
@@ -1355,6 +1366,173 @@ def bench_serving():
     return out
 
 
+def _bench_trace_overhead_impl():
+    """Paired tracing-ON vs tracing-OFF serving rows (ISSUE 10).
+
+    Same weights, same workload, same closed-loop load, two engines
+    that differ ONLY in ``serving.tracing`` — the on side pays the full
+    span load (root + queue/admit/decode/detok per request, plus the
+    slot loop's tick_dispatch/tick_wait/harvest), the off side runs the
+    disabled no-op tracer.  Acceptance bar: overhead <= 2% on sustained
+    captions/s (recorded honestly either way; the 1-core dev host's
+    noise floor rides in ``trace_overhead_host_cores``).
+
+    Env: BENCH_TRACE_REQS (requests per client per rep, default 40 —
+    short runs are dominated by 1-core scheduling noise),
+    BENCH_TRACE_CLIENTS (default 4), BENCH_TRACE_REPS (default 3 —
+    best-of pairing, same discipline as the other CPU pairs).
+    """
+    import threading
+
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.observability.trace import get_tracer
+    from cst_captioning_tpu.serving.batcher import ContinuousBatcher
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.metrics import ServingMetrics
+
+    reqs_per_client = int(os.environ.get("BENCH_TRACE_REQS", "40"))
+    n_clients = int(os.environ.get("BENCH_TRACE_CLIENTS", "4"))
+    reps = int(os.environ.get("BENCH_TRACE_REPS", "3"))
+
+    vocab = Vocabulary([f"w{i}" for i in range(1020)])
+
+    def build(tracing: bool):
+        cfg = get_preset("synthetic_smoke")
+        cfg.model.rnn_size = 128
+        cfg.model.input_encoding_size = 128
+        cfg.model.att_hidden_size = 128
+        cfg.data.feature_dims = {"resnet": 256}
+        cfg.data.max_frames = 8
+        cfg.model.vocab_size = len(vocab)
+        cfg.eval.beam_size = 3
+        cfg.eval.max_decode_len = 16
+        cfg.serving.decode_mode = "beam"
+        cfg.serving.max_batch_size = 8
+        cfg.serving.batch_shapes = [1, 2, 4, 8]
+        cfg.serving.num_slots = 8
+        cfg.serving.queue_depth = 4096
+        cfg.serving.slot_block_steps = 2
+        cfg.serving.tracing = tracing
+        return InferenceEngine(cfg, random_init=True, vocab=vocab)
+
+    rng = np.random.RandomState(23)
+    n_pool = 64
+    pool = [
+        {
+            "features": {
+                "resnet": rng.randn(8, 256).astype(np.float32)
+            }
+        }
+        for _ in range(n_pool)
+    ]
+
+    tracer = get_tracer()
+
+    def run_closed(engine, traced: bool):
+        engine.cache.captions.clear()
+        metrics = ServingMetrics()
+        batcher = ContinuousBatcher(engine, metrics)
+        lat_ms, errors = [], []
+        lock = threading.Lock()
+
+        def client(cid):
+            r = np.random.RandomState(7000 + cid)
+            for _ in range(reqs_per_client):
+                k = int(r.randint(0, n_pool))
+                trace = (
+                    (tracer.new_trace_id(), tracer.new_span_id())
+                    if traced else None
+                )
+                t0 = time.perf_counter()
+                try:
+                    batcher.submit(
+                        pool[k], deadline_ms=120_000.0, trace=trace
+                    )
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+                    continue
+                with lock:
+                    lat_ms.append((time.perf_counter() - t0) * 1e3)
+
+        with batcher:
+            threads = [
+                threading.Thread(target=client, args=(c,))
+                for c in range(n_clients)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.perf_counter() - t0
+        if errors:
+            raise RuntimeError(f"trace bench dropped requests: {errors[:3]}")
+        return (
+            len(lat_ms) / wall,
+            float(np.percentile(lat_ms, 99)) if lat_ms else 0.0,
+        )
+
+    eng_on = build(True)
+    eng_off = build(False)
+    best = {"on": (0.0, 0.0), "off": (0.0, 0.0)}
+    for _ in range(reps):
+        for key, eng, traced in (
+            ("on", eng_on, True), ("off", eng_off, False),
+        ):
+            cps, p99 = run_closed(eng, traced)
+            if cps > best[key][0]:
+                best[key] = (cps, p99)
+    on_cps, on_p99 = best["on"]
+    off_cps, off_p99 = best["off"]
+    spans = sum(1 for _ in tracer.spans())
+    return {
+        "trace_overhead_captions_per_sec_on": round(on_cps, 2),
+        "trace_overhead_captions_per_sec_off": round(off_cps, 2),
+        # sustained-throughput ratio on/off: 1.0 = free, 0.98 = the
+        # 2% acceptance bar.
+        "trace_overhead_ratio": round(on_cps / off_cps, 4),
+        "trace_overhead_pct": round(
+            (1.0 - on_cps / off_cps) * 100.0, 2
+        ),
+        "trace_overhead_p99_on_ms": round(on_p99, 2),
+        "trace_overhead_p99_off_ms": round(off_p99, 2),
+        "trace_overhead_p99_delta_ms": round(on_p99 - off_p99, 2),
+        "trace_overhead_spans": spans,
+        "trace_overhead_reqs": n_clients * reqs_per_client,
+        "trace_overhead_host_cores": float(os.cpu_count() or 1),
+    }
+
+
+def bench_trace_overhead():
+    """Tracing on/off serving pair (see
+    :func:`_bench_trace_overhead_impl`).  Re-execs into a CPU
+    subprocess (the bench_slot_mem precedent): the pairing targets the
+    smoke shape by design and must not disturb the TPU-held parent."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_TRACE_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"trace overhead child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    return json.loads(lines[-1])
+
+
 def _bench_slot_mem_impl():
     """Paired REPLICATED-vs-DEDUPED decode-state memory rows (ISSUE 7).
 
@@ -2455,6 +2633,16 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["replicas_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_TRACE", "1") == "1":
+        # Paired tracing-on/off serving rows (ISSUE 10): the span
+        # layer's cost on sustained captions/s + p99, measured in a
+        # CPU subprocess (degraded-mode safe) — the <=2% acceptance bar
+        # rides in trace_overhead_ratio.
+        try:
+            extra.update(bench_trace_overhead())
+        except Exception as e:  # noqa: BLE001
+            extra["trace_bench_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if os.environ.get("BENCH_SHARD", "1") == "1":
         # Paired replicated-vs-model-sharded XE rows on a >=4-device
         # mesh (ISSUE 9): inline on multi-device hosts, re-exec'd onto
@@ -2533,6 +2721,11 @@ if __name__ == "__main__":
         # (bench_slot_mem).
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_slot_mem_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_TRACE_CHILD") == "1":
+        # Re-exec'd tracing-on/off serving child (bench_trace_overhead).
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_trace_overhead_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_SHARD_CHILD") == "1":
         # Re-exec'd replicated-vs-model-sharded child (bench_shard):

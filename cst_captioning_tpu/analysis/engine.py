@@ -96,6 +96,7 @@ def _load_checkers() -> None:
         donation,
         jit_boundary,
         metrics_registry,
+        observability,
         partitioning,
         single_site,
         thread_safety,

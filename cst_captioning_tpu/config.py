@@ -214,6 +214,15 @@ class TrainConfig:
     remat: bool = False           # jax.checkpoint the decoder scan
     nan_check: bool = False       # debug nan-guard on losses/grads
     profile_dir: str = ""         # jax.profiler trace output ("" = off)
+    # Steps the profiler window stays open (trace covers steps
+    # 1..1+window of epoch 0) — the trainer-side twin of the serving
+    # /debug/profile?ms=N knob.
+    profile_window_steps: int = 10
+    # Write the span tracer's Chrome-trace JSON here at the end of fit()
+    # ("" = off).  PhaseClock phases are spans in the same format the
+    # serving /debug/trace export uses, so a CST step and a served
+    # request render in one Perfetto timeline.
+    trace_file: str = ""
     tensorboard_dir: str = ""     # tf.summary event files ("" = off)
     log_every: int = 20           # steps between loss log lines
     history_file: str = "history.json"
@@ -328,6 +337,27 @@ class ServingConfig:
     retry_after_s: float = 0.25   # hint returned on queue-full rejects
     caption_cache_size: int = 4096   # tier-1: content hash -> caption
     feature_cache_size: int = 512    # tier-2: feature id -> encoder state
+    # Span tracing (observability/trace.py): host-side spans over the
+    # whole request path (request/queue/admit/tick/harvest/detok),
+    # exported as Chrome-trace JSON at GET /debug/trace and stamped as
+    # exemplar trace_ids on /stats.  Off = every tracer handle is the
+    # disabled no-op tracer (the paired trace_overhead_* bench rows
+    # measure the difference).
+    tracing: bool = True
+    # Per-thread finished-span ring size (bounded memory; the export
+    # window an operator sees at /debug/trace).
+    trace_buffer_spans: int = 4096
+    # Flight recorder (observability/flight.py): per-replica ring of
+    # recent tick/lifecycle events, live at GET /debug/flight.  Ring
+    # length in events:
+    flight_events: int = 256
+    # Directory flight dumps are written to on worker death,
+    # kill_replica, watchdog/drain timeout, and SIGTERM drain.  "" =
+    # in-memory ring only (no disk writes — the test/dev default).
+    flight_dir: str = ""
+    # jax.profiler device-trace output dir for the opt-in
+    # GET /debug/profile?ms=N window.  "" disables the endpoint.
+    profile_dir: str = ""
     # Tier-2 byte budget (0 = entry-count bound only).  Projected
     # DecodeCache rows are the largest cached objects — bound the tier
     # by what it actually holds, not how many entries it has; evictions
@@ -498,6 +528,10 @@ def _preset_msrvtt_serve() -> Config:
     # Production default: replicate the engine over every local chip
     # (serving/replicas.py) with double-buffered dispatch.
     c.serving.replicas = 0
+    # Observability: flight dumps land next to the checkpoints on
+    # worker death / kill / SIGTERM drain; /debug/profile is live.
+    c.serving.flight_dir = "flight_dumps"
+    c.serving.profile_dir = "profiles"
     return c
 
 

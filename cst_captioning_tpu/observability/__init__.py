@@ -1,0 +1,32 @@
+"""End-to-end observability: span tracing, a crash flight recorder, and
+profiler hooks — one timeline format for serving and training.
+
+* ``trace``  — stdlib-only thread-safe span tracer (trace_id / span_id /
+  parent links, monotonic clocks, bounded per-thread ring buffers) with
+  Chrome-trace-event JSON export loadable in Perfetto, and the
+  ``SPAN_CATALOGUE`` registry every emitted span name must be in
+  (machine-checked by the CST-OBS analysis family).
+* ``flight`` — per-replica ring-buffer flight recorder of recent spans +
+  events, dumped to disk on worker death, ``kill_replica``, watchdog
+  timeout, and SIGTERM drain; readable live at ``GET /debug/flight``.
+
+Serving wires spans through the whole request path (``serving/server.py``
+opens a root span per request; the slot loop records the host-side
+dispatch/wait/harvest split) and training joins the same format
+(``training/steps.py::PhaseClock`` phases are spans), so one Perfetto
+timeline can show a CST step next to a served request.  Catalogue,
+endpoints, and how to read the timeline: docs/OBSERVABILITY.md.
+"""
+
+from cst_captioning_tpu.observability.flight import (  # noqa: F401
+    FlightRecorder,
+    validate_flight_dump,
+)
+from cst_captioning_tpu.observability.trace import (  # noqa: F401
+    EVENT_CATALOGUE,
+    SPAN_CATALOGUE,
+    Tracer,
+    get_tracer,
+    null_tracer,
+    validate_chrome_trace,
+)

@@ -32,11 +32,19 @@ fixed-shape discipline as training:
   feature-id -> projected encoder state (skips the encode GEMMs on the
   scan beam path via ``decoding.beam.beam_search_from_state``).
 * ``server``  — stdlib-only HTTP front end (``/v1/caption``,
-  ``/healthz``, ``/metrics``, ``/stats``); entry point
+  ``/healthz``, ``/metrics``, ``/stats``, plus the observability
+  surface: ``/debug/trace`` Chrome-trace export, ``/debug/flight``
+  live flight-recorder rings, ``/debug/profile?ms=N`` opt-in
+  jax.profiler windows); entry point
   ``python -m cst_captioning_tpu.cli.serve``.
 * ``metrics`` — per-stage latency histograms (queue / pad / device /
-  detokenize) + counters surfaced on ``/metrics``.
+  detokenize) + counters surfaced on ``/metrics`` with audited
+  ``# HELP``/``# TYPE`` lines and exemplar trace_ids on ``/stats``.
 
+Every request is also traced end to end (root span per HTTP request,
+queue/admit/decode/detok per request, host-side
+tick_dispatch/tick_wait/harvest in the slot loop) through
+``cst_captioning_tpu.observability`` — see docs/OBSERVABILITY.md.
 Architecture notes and the capacity/latency model live in
 ``docs/SERVING.md``.
 """

@@ -46,6 +46,8 @@ import time
 from collections import deque
 from typing import Any, Deque, Dict, List, Optional
 
+from cst_captioning_tpu.observability.flight import FlightRecorder
+from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
 from cst_captioning_tpu.serving.engine import InferenceEngine
 from cst_captioning_tpu.serving.metrics import ServingMetrics
 
@@ -79,9 +81,11 @@ class _Pending:
     # Future.
     _analysis_single_owner = True
 
-    __slots__ = ("prepared", "future", "t_enqueue", "t_admit", "deadline")
+    __slots__ = (
+        "prepared", "future", "t_enqueue", "t_admit", "deadline", "trace",
+    )
 
-    def __init__(self, prepared, deadline: float):
+    def __init__(self, prepared, deadline: float, trace=None):
         from concurrent.futures import Future
 
         self.prepared = prepared
@@ -89,6 +93,10 @@ class _Pending:
         self.t_enqueue = time.monotonic()
         self.t_admit = 0.0
         self.deadline = deadline
+        # (trace_id, root_span_id) of the HTTP root span, or None —
+        # written once here; the scheduler parents its queue/admit/
+        # decode/detok spans under it (observability/trace.py).
+        self.trace = trace
 
 
 class _BatcherBase:
@@ -130,7 +138,30 @@ class _BatcherBase:
         self._stop = False
         self._drain = True          # serve remaining work on stop
         self._draining = False      # admissions closed
+        self._drain_evented = False  # drain_start recorded once
         self._thread: Optional[threading.Thread] = None
+        # Observability (ISSUE 10): span tracer handle (the disabled
+        # no-op tracer when serving.tracing is off) + a flight recorder
+        # for the scheduler thread — recent ticks/lifecycle events,
+        # dumped on scheduler death / watchdog / drain.
+        self.tracer = (
+            get_tracer()
+            if getattr(sv, "tracing", True) else null_tracer()
+        )
+        self.flight = FlightRecorder(
+            self._flight_name(),
+            max_events=int(getattr(sv, "flight_events", 256)),
+            out_dir=str(getattr(sv, "flight_dir", "") or ""),
+            tracer=self.tracer,
+        )
+
+    def _flight_name(self) -> str:
+        return "scheduler"
+
+    def flight_snapshot(self) -> Dict[str, Any]:
+        """Live ``/debug/flight`` view: recorder name -> ring snapshot
+        (multi-recorder schedulers override)."""
+        return {self.flight.name: self.flight.snapshot()}
 
     # ----------------------------------------------------------- lifecycle
     def start(self):
@@ -138,6 +169,7 @@ class _BatcherBase:
             return self
         self._stop = False
         self._draining = False
+        self._drain_evented = False
         self._thread = threading.Thread(
             target=self._run, name=self._thread_name, daemon=True
         )
@@ -149,7 +181,13 @@ class _BatcherBase:
         queued and in-flight requests keep being served."""
         with self._cond:
             self._draining = True
+            evented, self._drain_evented = self._drain_evented, True
+            queued = len(self._q)
             self._cond.notify_all()
+        if not evented:
+            # Satellite (ISSUE 10): drains are reconstructable after
+            # the fact — start/requeue/exit land in the flight ring.
+            self.flight.event("drain_start", queued=queued)
 
     @property
     def draining(self) -> bool:
@@ -166,7 +204,11 @@ class _BatcherBase:
             self._drain = drain
             self._stop = True
             t = self._thread
+            evented, self._drain_evented = self._drain_evented, True
+            queued = len(self._q)
             self._cond.notify_all()
+        if not evented:
+            self.flight.event("drain_start", queued=queued, drain=drain)
         # Join OUTSIDE the lock: the scheduler thread needs _cond to
         # observe the stop and exit.  CST-THR-002: the handle is read
         # and cleared under _cond so concurrent stop() callers race on
@@ -214,10 +256,14 @@ class _BatcherBase:
         self,
         payload: Dict[str, Any],
         deadline_ms: Optional[float] = None,
+        trace: Optional[Any] = None,
     ) -> Dict[str, Any]:
         """Blocking request entry point (one caller thread per in-flight
         request — the HTTP front end's threading model).  Returns
-        ``{"caption", "tokens", "cached", "timings_ms"}``.
+        ``{"caption", "tokens", "cached", "timings_ms"}``.  ``trace``
+        is the front end's ``(trace_id, root_span_id)`` — the scheduler
+        parents this request's spans under it and the total-latency
+        histogram stamps the trace_id as its exemplar.
 
         Raises ``ValueError``/``KeyError`` (bad input),
         :class:`BackpressureError` (queue full),
@@ -228,6 +274,7 @@ class _BatcherBase:
             raise RuntimeError(f"{type(self).__name__} not started")
         if self._draining:
             raise ShuttingDownError("server is draining")
+        trace_id = trace[0] if trace else None
         t_submit = time.monotonic()
         prepared = self.engine.prepare(payload)
         hit = (
@@ -239,7 +286,7 @@ class _BatcherBase:
             self.metrics.requests_total.inc()
             self.metrics.requests_served.inc()
             total_ms = (time.monotonic() - t_submit) * 1e3
-            self.metrics.observe_stage("total", total_ms)
+            self.metrics.observe_stage("total", total_ms, exemplar=trace_id)
             return {
                 "caption": hit["caption"],
                 "tokens": hit["tokens"],
@@ -251,7 +298,7 @@ class _BatcherBase:
             if deadline_ms is not None
             else self.default_deadline_s
         )
-        pending = _Pending(prepared, t_submit + deadline_s)
+        pending = _Pending(prepared, t_submit + deadline_s, trace=trace)
         with self._cond:
             if self._draining:
                 raise ShuttingDownError("server is draining")
@@ -268,15 +315,21 @@ class _BatcherBase:
             raise
         finally:
             total_ms = (time.monotonic() - t_submit) * 1e3
-            self.metrics.observe_stage("total", total_ms)
+            self.metrics.observe_stage("total", total_ms, exemplar=trace_id)
         return result
 
     # ----------------------------------------------------------- scheduler
     def _run(self) -> None:
         try:
             self._loop()
-        except Exception:  # noqa: BLE001 — scheduler death is fatal
+        except Exception as e:  # noqa: BLE001 — scheduler death is fatal
             _log.exception("scheduler thread died")
+            # Post-mortem before anything else: the ring holds the last
+            # ticks that led here.
+            self.flight.event(
+                "worker_death", error=f"{type(e).__name__}: {e}"
+            )
+            self.flight.dump("worker_death")
             with self._cond:
                 self._draining = True
                 while self._q:
@@ -289,6 +342,24 @@ class _BatcherBase:
 
     def _loop(self) -> None:  # pragma: no cover — abstract
         raise NotImplementedError
+
+    def _record_request_spans(
+        self, live, t_tick: float, t_admit: float, tags=None
+    ) -> None:
+        """Per-request queue/admit spans for one admission tick, each
+        parented under its request's HTTP root span."""
+        for p in live:
+            if p.trace is None:
+                continue
+            tid, root = p.trace
+            self.tracer.record(
+                "queue", p.t_enqueue, t_tick,
+                trace_id=tid, parent_id=root, tags=tags,
+            )
+            self.tracer.record(
+                "admit", t_tick, t_admit,
+                trace_id=tid, parent_id=root, tags=tags,
+            )
 
     def _expire(self, p: _Pending, now: float) -> None:
         self.metrics.requests_expired.inc()
@@ -379,6 +450,13 @@ class MicroBatcher(_BatcherBase):
                 )
         if not live:
             return
+        for p in live:
+            if p.trace is not None:
+                self.tracer.record(
+                    "queue", p.t_enqueue, now,
+                    trace_id=p.trace[0], parent_id=p.trace[1],
+                )
+        t_d0 = time.monotonic()
         try:
             results = self.engine.decode_prepared(
                 [p.prepared for p in live]
@@ -389,6 +467,10 @@ class MicroBatcher(_BatcherBase):
                 if not p.future.done():
                     p.future.set_exception(e)
             return
+        self.tracer.record(
+            "batch_decode", t_d0, time.monotonic(),
+            tags={"batch": len(live)},
+        )
         n = len(live)
         B = self.engine.bucket(n)
         self.metrics.batches_total.inc()
@@ -442,6 +524,10 @@ class ContinuousBatcher(_BatcherBase):
                     if not self._drain:
                         break
                     if not self._q and not decoder.occupied:
+                        self.flight.event("drain_exit", served_all=True)
+                        # SIGTERM/stop drain completed: leave the
+                        # post-mortem record (no-op without flight_dir).
+                        self.flight.dump("drain")
                         return
                     if drain_deadline is None:
                         drain_deadline = (
@@ -468,7 +554,14 @@ class ContinuousBatcher(_BatcherBase):
                 drain_deadline is not None
                 and time.monotonic() > drain_deadline
             ):
+                self.flight.event(
+                    "watchdog",
+                    queued=len(admits),
+                    occupied=decoder.n_occupied,
+                )
+                self.flight.dump("watchdog")
                 self._abandon(decoder, admits, "drain deadline exceeded")
+                self.flight.event("drain_exit", served_all=False)
                 return
 
             now = time.monotonic()
@@ -480,6 +573,7 @@ class ContinuousBatcher(_BatcherBase):
                     live.append(p)
             # One compiled call per iteration: batched admission scatter
             # (padded-bucket encode) fused with the decode-step block.
+            t_tick = time.monotonic()
             try:
                 done = decoder.tick([p.prepared for p in live], live)
             except Exception as e:  # noqa: BLE001
@@ -500,10 +594,17 @@ class ContinuousBatcher(_BatcherBase):
                 self.metrics.observe_stage(
                     "admission", (t_admit - p.t_enqueue) * 1e3
                 )
+            self._record_request_spans(live, t_tick, t_admit)
             if live:
                 self.metrics.slots_admitted_total.inc(len(live))
             if decoder.occupied or live:
                 self.metrics.slot_steps_total.inc(decoder.block)
+                self.flight.event(
+                    "tick",
+                    admits=len(live),
+                    done=len(done),
+                    occupied=decoder.n_occupied,
+                )
             self.metrics.slots_occupied.set(decoder.n_occupied)
             if done:
                 self._resolve(decoder.harvest_many(done))
@@ -522,6 +623,13 @@ class ContinuousBatcher(_BatcherBase):
         for p, tokens, score, steps in harvested:
             self.metrics.steps_per_caption.observe(steps)
             self.metrics.observe_stage("device", (t0 - p.t_admit) * 1e3)
+            if p.trace is not None:
+                self.tracer.record(
+                    "decode", p.t_admit, t0,
+                    trace_id=p.trace[0], parent_id=p.trace[1],
+                    tags={"steps": steps},
+                )
+            td0 = time.monotonic()
             try:
                 res = self.engine.result_from_tokens(
                     p.prepared,
@@ -537,6 +645,11 @@ class ContinuousBatcher(_BatcherBase):
                     p.future.set_exception(e)
                 continue
             t1 = time.monotonic()
+            if p.trace is not None:
+                self.tracer.record(
+                    "detok", td0, t1,
+                    trace_id=p.trace[0], parent_id=p.trace[1],
+                )
             self.metrics.observe_stage("detok", (t1 - t0) * 1e3)
             self.metrics.requests_served.inc()
             if not p.future.done():

@@ -705,15 +705,25 @@ class InferenceEngine:
                 "its device group and cannot be cloned per-device — "
                 "replica scaling requires model_shards=1"
             )
+        # Warm AFTER the replica identity lands: the slot decoder reads
+        # ``engine.device`` (slot-matrix placement) and
+        # ``engine.replica_id`` (span tags) at construction, and ctor
+        # warmup would build it before either is set.
+        cfg2 = copy.deepcopy(self.cfg)
+        warm = cfg2.serving.warmup
+        cfg2.serving.warmup = False
         eng = InferenceEngine(
-            copy.deepcopy(self.cfg),
+            cfg2,
             params=jax.device_put(self.params, device),
             vocab=self.vocab,
             cache=self.cache,
         )
+        eng.cfg.serving.warmup = warm
         eng.params_tag = self.params_tag
         eng.device = device
         eng.replica_id = replica_id
+        if warm:
+            eng.warmup()
         return eng
 
     def slot_decoder(self):
@@ -727,6 +737,29 @@ class InferenceEngine:
         return self._slot_decoder
 
     # ----------------------------------------------------------- info
+    def _mesh_shape_str(self) -> str:
+        """"1x2"-style mesh string when model-sharded, "1x1" otherwise
+        (the same ``*_mesh_shape`` format bench records use)."""
+        if self.tp_mesh is None:
+            return "1x1"
+        return "x".join(
+            str(self.tp_mesh.shape[a]) for a in self.tp_mesh.axis_names
+        )
+
+    def fingerprint(self) -> Dict[str, Any]:
+        """The build/config fingerprint (ISSUE 10 satellite): the four
+        identifiers that correlate a flight dump, a bench record, and a
+        running deploy — surfaced on /healthz, /stats, and
+        /debug/flight."""
+        from cst_captioning_tpu import __version__
+
+        return {
+            "params_tag": self.params_tag,
+            "mesh_shape": self._mesh_shape_str(),
+            "preset": self.cfg.name,
+            "version": __version__,
+        }
+
     def describe(self) -> Dict[str, Any]:
         return {
             "model": self.cfg.name,
@@ -747,13 +780,8 @@ class InferenceEngine:
             "max_frames": self.cfg.data.max_frames,
             "vocab_size": len(self.vocab),
             "backend": jax.default_backend(),
-            # 1x2-style mesh string when model-sharded, "1x1" otherwise
-            # (the same *_mesh_shape format bench records use).
-            "mesh_shape": (
-                "1x1" if self.tp_mesh is None
-                else "x".join(
-                    str(self.tp_mesh.shape[a])
-                    for a in self.tp_mesh.axis_names
-                )
-            ),
+            "mesh_shape": self._mesh_shape_str(),
+            # Deploy fingerprint: params_tag/mesh/preset/version —
+            # /healthz carries it so dumps and bench records correlate.
+            "build": self.fingerprint(),
         }

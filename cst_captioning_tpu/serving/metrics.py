@@ -75,6 +75,54 @@ METRIC_FAMILIES = [
     ("caption_cache_*", "gauge"),
 ]
 
+# One-line HELP text per family (Prometheus text-format audit, ISSUE
+# 10): ``to_prometheus`` emits ``# HELP`` + ``# TYPE`` for EVERY
+# exposed series from this table — a family without help text fails
+# loudly at render time, and the parser-based test in
+# tests/test_observability.py pins the exposition format instead of
+# substring checks.  Keys are the registered family patterns above.
+METRIC_HELP = {
+    "caption_requests_total": "Requests accepted into the pipeline.",
+    "caption_requests_served_total": "Requests resolved with a caption.",
+    "caption_requests_rejected_total":
+        "Requests rejected by queue-full backpressure (HTTP 429).",
+    "caption_requests_expired_total":
+        "Requests whose deadline passed before a result (HTTP 504).",
+    "caption_requests_failed_total":
+        "Requests failed by engine or input errors (HTTP 5xx).",
+    "caption_batches_total": "Coalesced batches dispatched (ladder mode).",
+    "caption_batch_rows_total": "Live request rows across batches.",
+    "caption_batch_pad_rows_total":
+        "Padding rows dispatched (wasted device rows).",
+    "caption_slots_admitted_total":
+        "Requests admitted into decode slots (continuous mode).",
+    "caption_slot_device_steps_total": "Device decode steps dispatched.",
+    "caption_slot_bank_resizes_total":
+        "Elastic slot-bank grow/shrink transitions.",
+    "caption_slots_total": "Configured decode slots (current bank).",
+    "caption_slots_occupied": "Decode slots occupied right now.",
+    "caption_decode_state_bytes":
+        "Live bytes of the resident decode-slot pytree.",
+    "caption_slot_bank_size": "Current elastic slot-bank size.",
+    "caption_replica_healthy": "1 while the replica is routed, 0 drained.",
+    "caption_replica_slots_occupied": "Occupied slots on this replica.",
+    "caption_replica_queue_depth": "Queued requests on this replica.",
+    "caption_replica_captions_total": "Captions served by this replica.",
+    "caption_replica_admitted_total":
+        "Requests admitted into this replica's slots.",
+    "caption_replica_device_steps_total":
+        "Device decode steps run by this replica.",
+    "caption_replica_decode_state_bytes":
+        "Live decode-state bytes on this replica.",
+    "caption_replica_slot_bank_size":
+        "This replica's current elastic slot-bank size.",
+    "caption_latency_*_ms":
+        "Per-stage request latency in milliseconds.",
+    "caption_steps_per_caption":
+        "Device decode steps each caption paid before its slot freed.",
+    "caption_cache_*": "Two-tier cache counters (hits/misses/bytes/...).",
+}
+
 
 class Counter:
     """Thread-safe monotonically-increasing counter."""
@@ -121,9 +169,14 @@ class LatencyHistogram:
         self._sum = 0.0
         self._count = 0
         self._max = 0.0
+        # Exemplar-style anchor (ISSUE 10): the trace_id of the most
+        # recent observation that carried one, with its value — /stats
+        # surfaces it so an operator can jump from a histogram to the
+        # exact /debug/trace timeline that produced a latency.
+        self._exemplar: Optional[Dict[str, float]] = None
         self._lock = threading.Lock()
 
-    def observe(self, ms: float) -> None:
+    def observe(self, ms: float, exemplar: Optional[str] = None) -> None:
         ms = float(ms)
         i = 0
         for i, b in enumerate(self.bounds):  # noqa: B007
@@ -137,6 +190,10 @@ class LatencyHistogram:
             self._count += 1
             if ms > self._max:
                 self._max = ms
+            if exemplar is not None:
+                self._exemplar = {
+                    "trace_id": exemplar, "value_ms": round(ms, 4)
+                }
 
     @property
     def count(self) -> int:
@@ -172,7 +229,8 @@ class LatencyHistogram:
             total = self._count
             s = self._sum
             mx = self._max
-        return {
+            ex = dict(self._exemplar) if self._exemplar else None
+        out = {
             "count": total,
             "mean_ms": round(s / total, 4) if total else 0.0,
             "p50_ms": round(self.percentile(50), 4),
@@ -180,6 +238,9 @@ class LatencyHistogram:
             "p99_ms": round(self.percentile(99), 4),
             "max_ms": round(mx, 4),
         }
+        if ex is not None:
+            out["exemplar"] = ex
+        return out
 
     def bucket_counts(self) -> List[int]:
         with self._lock:
@@ -252,8 +313,10 @@ class ServingMetrics:
         with self._replicas_lock:
             return sorted(self._replicas.items())
 
-    def observe_stage(self, stage: str, ms: float) -> None:
-        self.stages[stage].observe(ms)
+    def observe_stage(
+        self, stage: str, ms: float, exemplar: Optional[str] = None
+    ) -> None:
+        self.stages[stage].observe(ms, exemplar=exemplar)
 
     def mean_batch_size(self) -> float:
         b = self.batches_total.value
@@ -308,9 +371,22 @@ class ServingMetrics:
             d["cache"] = cache_stats
         return d
 
+    @staticmethod
+    def _header(lines: List[str], name: str, family: str, typ: str) -> None:
+        """``# HELP`` + ``# TYPE`` for one exposed metric name.  Every
+        sample family gets both lines, in that order, exactly once —
+        the text-format contract the parser-based exposition test pins.
+        ``family`` is the registered pattern the name belongs to (the
+        METRIC_HELP key); a family without help text is a KeyError at
+        render time, on purpose."""
+        lines.append(f"# HELP {name} {METRIC_HELP[family]}")
+        lines.append(f"# TYPE {name} {typ}")
+
     def to_prometheus(self, cache_stats: Optional[Dict] = None) -> str:
         """Prometheus text exposition of the same numbers (histograms as
-        cumulative ``_bucket`` series, the standard encoding)."""
+        cumulative ``_bucket`` series, the standard encoding).  Serve it
+        with content type ``text/plain; version=0.0.4; charset=utf-8``
+        (the front end does)."""
         lines: List[str] = []
         counters = {
             "caption_requests_total": self.requests_total,
@@ -326,7 +402,7 @@ class ServingMetrics:
             "caption_slot_bank_resizes_total": self.slot_bank_resizes,
         }
         for name, c in counters.items():
-            lines.append(f"# TYPE {name} counter")
+            self._header(lines, name, name, "counter")
             lines.append(f"{name} {c.value}")
         for name, g in (
             ("caption_slots_total", self.slots_total),
@@ -334,7 +410,7 @@ class ServingMetrics:
             ("caption_decode_state_bytes", self.decode_state_bytes),
             ("caption_slot_bank_size", self.slot_bank_size),
         ):
-            lines.append(f"# TYPE {name} gauge")
+            self._header(lines, name, name, "gauge")
             lines.append(f"{name} {g.value}")
         reps = self._replica_items()
         if reps:
@@ -357,20 +433,22 @@ class ServingMetrics:
                  lambda rm: rm.slot_bank_size.value),
             )
             for name, typ, read in families:
-                lines.append(f"# TYPE {name} {typ}")
+                self._header(lines, name, name, typ)
                 for rid, rm in reps:
                     lines.append(
                         f'{name}{{replica="{rid}"}} {read(rm)}'
                     )
         hists = {
             **{
-                f"caption_latency_{s}_ms": h
+                f"caption_latency_{s}_ms": ("caption_latency_*_ms", h)
                 for s, h in self.stages.items()
             },
-            "caption_steps_per_caption": self.steps_per_caption,
+            "caption_steps_per_caption": (
+                "caption_steps_per_caption", self.steps_per_caption
+            ),
         }
-        for name, h in hists.items():
-            lines.append(f"# TYPE {name} histogram")
+        for name, (family, h) in hists.items():
+            self._header(lines, name, family, "histogram")
             cum = 0
             counts = h.bucket_counts()
             for bound, c in zip(h.bounds, counts):
@@ -390,7 +468,7 @@ class ServingMetrics:
                     "evictions",
                 ):
                     if k in st:
-                        lines.append(
-                            f"caption_cache_{tier}_{k} {st[k]}"
-                        )
+                        name = f"caption_cache_{tier}_{k}"
+                        self._header(lines, name, "caption_cache_*", "gauge")
+                        lines.append(f"{name} {st[k]}")
         return "\n".join(lines) + "\n"

@@ -58,6 +58,8 @@ import time
 from collections import deque
 from typing import Any, Deque, List, Optional, Sequence
 
+from cst_captioning_tpu.observability.flight import FlightRecorder
+from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
 from cst_captioning_tpu.serving.batcher import (
     BackpressureError,
     ShuttingDownError,
@@ -116,6 +118,23 @@ class Replica:
         self.q: Deque[_Pending] = deque()
         self.healthy = True
         self.thread: Optional[threading.Thread] = None
+        # Per-replica flight recorder (observability/flight.py): the
+        # last ticks + lifecycle events of THIS replica, dumped on
+        # worker death / kill_replica / watchdog / SIGTERM drain and
+        # readable live at /debug/flight.  Tagged with the replica id
+        # so the dump also carries this replica's recent spans.
+        sv = engine.cfg.serving
+        tracer = (
+            get_tracer()
+            if getattr(sv, "tracing", True) else null_tracer()
+        )
+        self.flight = FlightRecorder(
+            f"replica{rid}",
+            max_events=int(getattr(sv, "flight_events", 256)),
+            out_dir=str(getattr(sv, "flight_dir", "") or ""),
+            tracer=tracer,
+            tags={"replica": rid},
+        )
 
     def free_capacity(self) -> int:
         """Free slots net of already-queued work (can go negative —
@@ -222,13 +241,35 @@ class ReplicaSet(_BatcherBase):
             t.start()
         return self
 
+    def flight_snapshot(self):
+        """Live ``/debug/flight`` view: one ring per replica."""
+        return {
+            rep.flight.name: rep.flight.snapshot()
+            for rep in self.replicas
+        }
+
+    def begin_drain(self) -> None:
+        with self._cond:
+            self._draining = True
+            evented, self._drain_evented = self._drain_evented, True
+            depths = [len(r.q) for r in self.replicas]
+            self._cond.notify_all()
+        if not evented:
+            for rep, d in zip(self.replicas, depths):
+                rep.flight.event("drain_start", queued=d)
+
     def stop(self, drain: bool = True) -> None:
         with self._cond:
             self._draining = True
             self._drain = drain
             self._stop = True
             threads = list(self._threads)
+            evented, self._drain_evented = self._drain_evented, True
+            depths = [len(r.q) for r in self.replicas]
             self._cond.notify_all()
+        if not evented:
+            for rep, d in zip(self.replicas, depths):
+                rep.flight.event("drain_start", queued=d, drain=drain)
         # Join OUTSIDE the lock — workers need _cond to observe the
         # stop and drain out.
         for t in threads:
@@ -259,6 +300,7 @@ class ReplicaSet(_BatcherBase):
         """Operational drain of one replica: mark it unhealthy and stop
         routing to it; its worker requeues the replica's queued and
         in-flight requests onto survivors (deadline-bounded)."""
+        self.replicas[rid].flight.event("kill")
         with self._cond:
             self.replicas[rid].healthy = False
             self._cond.notify_all()
@@ -281,8 +323,11 @@ class ReplicaSet(_BatcherBase):
             self._worker_loop(rep)
         except _ReplicaDied:
             self._drain_replica(rep, f"replica {rep.rid} killed")
-        except Exception:  # noqa: BLE001 — any worker death drains it
+        except Exception as e:  # noqa: BLE001 — any worker death drains it
             _log.exception("replica %d worker died", rep.rid)
+            rep.flight.event(
+                "worker_death", error=f"{type(e).__name__}: {e}"
+            )
             self._drain_replica(rep, f"replica {rep.rid} worker died")
 
     def _worker_loop(self, rep: Replica) -> None:
@@ -312,6 +357,10 @@ class ReplicaSet(_BatcherBase):
                         and not decoder.occupied
                         and outstanding is None
                     ):
+                        rep.flight.event("drain_exit", served_all=True)
+                        # SIGTERM/stop drain completed: leave the
+                        # post-mortem record (no-op without flight_dir).
+                        rep.flight.dump("drain")
                         return
                     if drain_deadline is None:
                         drain_deadline = (
@@ -344,7 +393,14 @@ class ReplicaSet(_BatcherBase):
                 drain_deadline is not None
                 and time.monotonic() > drain_deadline
             ):
+                rep.flight.event(
+                    "watchdog",
+                    queued=len(admits),
+                    occupied=decoder.n_occupied,
+                )
+                rep.flight.dump("watchdog")
                 self._abandon(rep, admits, "drain deadline exceeded")
+                rep.flight.event("drain_exit", served_all=False)
                 return
 
             now = time.monotonic()
@@ -356,6 +412,7 @@ class ReplicaSet(_BatcherBase):
                     live.append(p)
             # Dispatch tick t+1 FIRST (double buffer) so the harvest of
             # tick t below overlaps its device compute.
+            t_tick = time.monotonic()
             try:
                 handle = decoder.tick_begin(
                     [p.prepared for p in live], live
@@ -377,12 +434,22 @@ class ReplicaSet(_BatcherBase):
                 self.metrics.observe_stage(
                     "admission", (t_admit - p.t_enqueue) * 1e3
                 )
+            self._record_request_spans(
+                live, t_tick, t_admit, tags={"replica": rep.rid}
+            )
             if live:
                 self.metrics.slots_admitted_total.inc(len(live))
                 rm.admitted_total.inc(len(live))
             if handle is not None:
                 self.metrics.slot_steps_total.inc(decoder.block)
                 rm.steps_total.inc(decoder.block)
+                rep.flight.event(
+                    "tick",
+                    # stub decoders in tests hand back bare tuples
+                    seq=getattr(handle, "seq", None),
+                    admits=len(live),
+                    occupied=decoder.n_occupied,
+                )
             rm.slots_occupied.set(decoder.n_occupied)
             self.metrics.slots_occupied.set(
                 sum(r.decoder.n_occupied for r in self.replicas)
@@ -412,6 +479,13 @@ class ReplicaSet(_BatcherBase):
         for p, tokens, score, steps in harvested:
             self.metrics.steps_per_caption.observe(steps)
             self.metrics.observe_stage("device", (t0 - p.t_admit) * 1e3)
+            if p.trace is not None:
+                self.tracer.record(
+                    "decode", p.t_admit, t0,
+                    trace_id=p.trace[0], parent_id=p.trace[1],
+                    tags={"replica": rep.rid, "steps": steps},
+                )
+            td0 = time.monotonic()
             try:
                 res = rep.engine.result_from_tokens(
                     p.prepared,
@@ -427,6 +501,12 @@ class ReplicaSet(_BatcherBase):
                     p.future.set_exception(e)
                 continue
             t1 = time.monotonic()
+            if p.trace is not None:
+                self.tracer.record(
+                    "detok", td0, t1,
+                    trace_id=p.trace[0], parent_id=p.trace[1],
+                    tags={"replica": rep.rid},
+                )
             self.metrics.observe_stage("detok", (t1 - t0) * 1e3)
             self.metrics.requests_served.inc()
             rm.captions_total.inc()
@@ -502,6 +582,15 @@ class ReplicaSet(_BatcherBase):
                 sum(r.decoder.S for r in self.replicas if r.healthy)
             )
             self._cond.notify_all()
+        # Post-mortem: the requeue outcome is part of the story an
+        # operator needs to reconstruct, and the ring still holds the
+        # replica's last ticks — dump it now, while both exist.
+        rep.flight.event(
+            "drain_requeue",
+            requeued=requeued, expired=expired, failed=failed,
+            survivors=self.healthy_replicas,
+        )
+        rep.flight.dump(why)
         _log.warning(
             "%s: drained from routing (%d requeued, %d expired, "
             "%d failed; %d healthy replicas remain)",

@@ -8,12 +8,28 @@ Endpoints:
   input), 404 (unknown ``feature_id`` with no features), 429 (queue
   full; ``Retry-After`` header set), 503 (draining/shutdown), 504
   (deadline exceeded), 500 (engine failure).
-* ``GET /healthz`` — liveness + engine description (+ replica health
-  under the multi-replica scheduler: 503 only when ZERO replicas are
-  healthy — individual replica deaths degrade capacity, not health).
+* ``GET /healthz`` — liveness + engine description + the deploy
+  fingerprint (``build``: params_tag / mesh_shape / preset / version —
+  the correlation key between flight dumps, bench records, and a
+  running process) (+ replica health under the multi-replica scheduler:
+  503 only when ZERO replicas are healthy — individual replica deaths
+  degrade capacity, not health).
 * ``GET /metrics`` — Prometheus text exposition (per-stage latency
-  histograms, slot occupancy, request counters, cache tiers).
-* ``GET /stats``  — the same numbers as one JSON object.
+  histograms, slot occupancy, request counters, cache tiers; every
+  family carries ``# HELP``/``# TYPE``).
+* ``GET /stats``  — the same numbers as one JSON object, plus the
+  ``build`` fingerprint and exemplar trace_ids on the latency
+  histograms (jump from a p99 to the exact timeline that produced it).
+* ``GET /debug/trace``  — the span tracer's buffered spans as
+  Chrome-trace-event JSON (load in Perfetto); every ``POST
+  /v1/caption`` opens a root span whose trace_id is echoed in the
+  ``X-Trace-Id`` response header.
+* ``GET /debug/flight`` — the live per-replica flight-recorder rings
+  (recent ticks + lifecycle events; dumped to disk on worker death /
+  kill / watchdog / SIGTERM drain when ``serving.flight_dir`` is set).
+* ``GET /debug/profile?ms=N`` — opt-in ``jax.profiler`` device trace
+  window (requires ``serving.profile_dir``; 409 while one is already
+  running).
 
 ``ThreadingHTTPServer`` gives one thread per in-flight request, which
 matches the batcher ``submit`` blocking contract; the batcher's bounded
@@ -38,9 +54,12 @@ import json
 import logging
 import signal
 import threading
+import time
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, Optional, Tuple
 
+from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
 from cst_captioning_tpu.serving.batcher import (
     BackpressureError,
     ContinuousBatcher,
@@ -89,7 +108,8 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------------ handlers
     def do_GET(self) -> None:  # noqa: N802 (stdlib casing)
         srv = self.server
-        if self.path == "/healthz":
+        route, _, query = self.path.partition("?")
+        if route == "/healthz":
             status = "draining" if srv.draining else "ok"
             info = srv.engine.describe()
             code = 200
@@ -105,16 +125,61 @@ class _Handler(BaseHTTPRequestHandler):
                 if healthy == 0:
                     status, code = "unhealthy", 503
             self._send_json(code, {"status": status, **info})
-        elif self.path == "/metrics":
+        elif route == "/metrics":
             body = srv.metrics.to_prometheus(
                 srv.engine.cache.stats()
             ).encode()
-            self._send(200, body, "text/plain; version=0.0.4")
-        elif self.path == "/stats":
+            self._send(
+                200, body, "text/plain; version=0.0.4; charset=utf-8"
+            )
+        elif route == "/stats":
             self._send_json(
                 200,
-                srv.metrics.to_dict(srv.engine.cache.stats()),
+                {
+                    "build": srv.engine.fingerprint(),
+                    **srv.metrics.to_dict(srv.engine.cache.stats()),
+                },
             )
+        elif route == "/debug/trace":
+            if not srv.tracer.enabled:
+                self._send_json(
+                    404, {"error": "tracing disabled (serving.tracing)"}
+                )
+                return
+            self._send_json(200, srv.tracer.export_chrome_trace())
+        elif route == "/debug/flight":
+            snap = getattr(srv.batcher, "flight_snapshot", None)
+            self._send_json(
+                200,
+                {
+                    "build": srv.engine.fingerprint(),
+                    "recorders": snap() if snap is not None else {},
+                },
+            )
+        elif route == "/debug/profile":
+            if not srv.profile_dir:
+                self._send_json(
+                    404,
+                    {"error": "profiling disabled — set "
+                              "serving.profile_dir to enable"},
+                )
+                return
+            try:
+                q = urllib.parse.parse_qs(query)
+                ms = float(q.get("ms", ["1000"])[0])
+                if not 0 < ms <= 60_000:
+                    raise ValueError(f"ms={ms} outside (0, 60000]")
+            except ValueError as e:
+                self._send_json(400, {"error": f"bad profile window: {e}"})
+                return
+            if srv.start_profile(ms):
+                self._send_json(
+                    202, {"profiling_ms": ms, "out_dir": srv.profile_dir}
+                )
+            else:
+                self._send_json(
+                    409, {"error": "a profile window is already running"}
+                )
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
 
@@ -141,28 +206,57 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request body: {e}"})
             return
         deadline_ms = payload.get("deadline_ms")
+        srv = self.server
+        # Root span per request: the trace_id is echoed in the
+        # X-Trace-Id header (success AND error responses) and threaded
+        # to the scheduler so queue/admit/decode/detok spans parent
+        # under this one (observability/trace.py).
+        trace = None
+        hdrs: Dict[str, str] = {}
+        if srv.tracer.enabled:
+            trace = (srv.tracer.new_trace_id(), srv.tracer.new_span_id())
+            hdrs["X-Trace-Id"] = trace[0]
+        t_root = time.monotonic()
+        status = 500
         try:
-            result = self.server.batcher.submit(
-                payload, deadline_ms=deadline_ms
+            result = srv.batcher.submit(
+                payload, deadline_ms=deadline_ms, trace=trace
             )
-            self._send_json(200, result)
+            status = 200
+            self._send_json(200, result, headers=hdrs)
         except BackpressureError as e:
+            status = 429
             self._send_json(
                 429,
                 {"error": str(e), "retry_after_s": e.retry_after_s},
-                headers={"Retry-After": f"{e.retry_after_s:.3f}"},
+                headers=dict(
+                    hdrs, **{"Retry-After": f"{e.retry_after_s:.3f}"}
+                ),
             )
         except ShuttingDownError as e:
-            self._send_json(503, {"error": str(e)})
+            status = 503
+            self._send_json(503, {"error": str(e)}, headers=hdrs)
         except DeadlineExceededError as e:
-            self._send_json(504, {"error": str(e)})
+            status = 504
+            self._send_json(504, {"error": str(e)}, headers=hdrs)
         except KeyError as e:
-            self._send_json(404, {"error": str(e)})
+            status = 404
+            self._send_json(404, {"error": str(e)}, headers=hdrs)
         except (ValueError, TypeError) as e:
-            self._send_json(400, {"error": str(e)})
+            status = 400
+            self._send_json(400, {"error": str(e)}, headers=hdrs)
         except Exception as e:  # noqa: BLE001 — last-resort 500
             _log.exception("caption request failed")
-            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            self._send_json(
+                500, {"error": f"{type(e).__name__}: {e}"}, headers=hdrs
+            )
+        finally:
+            if trace is not None:
+                srv.tracer.record(
+                    "request", t_root, time.monotonic(),
+                    trace_id=trace[0], span_id=trace[1],
+                    tags={"status": status},
+                )
 
 
 class _Server(ThreadingHTTPServer):
@@ -170,6 +264,8 @@ class _Server(ThreadingHTTPServer):
     engine: InferenceEngine
     batcher: Any
     metrics: ServingMetrics
+    tracer: Any
+    profile_dir: str
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
@@ -177,10 +273,52 @@ class _Server(ThreadingHTTPServer):
         # control threads (SIGTERM handler, context exits) — an Event,
         # not a bare bool, so the cross-thread handoff is explicit.
         self._draining_evt = threading.Event()
+        # /debug/profile window state: handler threads race to start
+        # one; the flag and its flip live under this lock (CST-THR-002).
+        self._profile_lock = threading.Lock()
+        self._profiling = False
+        self.profile_dir = ""
 
     @property
     def draining(self) -> bool:
         return self._draining_evt.is_set()
+
+    def start_profile(self, ms: float) -> bool:
+        """Open a ``jax.profiler`` device-trace window of ``ms``
+        milliseconds into ``profile_dir`` on a background thread.
+        Returns False when a window is already running (HTTP 409)."""
+        with self._profile_lock:
+            if self._profiling:
+                return False
+            self._profiling = True
+
+        def _window() -> None:
+            import jax
+
+            t0 = time.monotonic()
+            try:
+                jax.profiler.start_trace(self.profile_dir)
+                time.sleep(ms / 1e3)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:  # noqa: BLE001 — stop is best-effort
+                    _log.exception("profiler stop_trace failed")
+                self.tracer.record(
+                    "profile", t0, time.monotonic(),
+                    tags={"ms": ms, "out_dir": self.profile_dir},
+                )
+                with self._profile_lock:
+                    self._profiling = False
+            _log.info(
+                "profiler window (%.0fms) written to %s",
+                ms, self.profile_dir,
+            )
+
+        threading.Thread(
+            target=_window, name="caption-profile", daemon=True
+        ).start()
+        return True
 
 
 class CaptionServer:
@@ -218,6 +356,10 @@ class CaptionServer:
         self._http.engine = engine
         self._http.batcher = self.batcher
         self._http.metrics = self.metrics
+        self._http.tracer = (
+            get_tracer() if sv.tracing else null_tracer()
+        )
+        self._http.profile_dir = str(sv.profile_dir or "")
         self._thread: Optional[threading.Thread] = None
         self._shutdown_lock = threading.Lock()
         self._closed = False

@@ -149,6 +149,7 @@ from cst_captioning_tpu.decoding.core import (
     register_backend,
 )
 from cst_captioning_tpu.models.captioner import DecodeCache
+from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
 
 _log = logging.getLogger("cst_captioning_tpu.serving")
 
@@ -256,6 +257,18 @@ class SlotDecoder:
         self.last_resize_ms = 0.0
         self.worst_resize_ms = 0.0
         self._shrink_streak = 0
+        # Host-side span tracing (observability/trace.py): the loop's
+        # dispatch/wait/harvest split is recorded around the HOST calls
+        # only — zero tracing inside jitted code (CST-OBS-003); the
+        # async tick handles are what make the host-vs-device split
+        # honest.  Replica engines tag every span with their id.
+        self.tracer = (
+            get_tracer() if getattr(sv, "tracing", True) else null_tracer()
+        )
+        rid = getattr(engine, "replica_id", None)
+        self.span_tags: Dict[str, Any] = (
+            {} if rid is None else {"replica": rid}
+        )
         # Last dispatched handle (sync-path harvest target) and a host
         # snapshot cache keyed by handle seq (fetched lazily, at most
         # once per handle).
@@ -748,6 +761,7 @@ class SlotDecoder:
         n = len(prepared)
         if n == 0 and not self.occupied:
             return None
+        t_begin = time.monotonic()
         if n > len(self.free) or n > self.admit_cap:
             raise RuntimeError(
                 f"tick admitting {n} exceeds free={len(self.free)} "
@@ -783,6 +797,13 @@ class SlotDecoder:
         )
         handle = TickHandle(self._seq, done, seqs_d, scores_d)
         self._last_handle = handle
+        # Host side of the tick only: the dispatch returns before the
+        # device work completes; tick_wait's span carries the exposed
+        # device residual.
+        self.tracer.record(
+            "tick_dispatch", t_begin, time.monotonic(),
+            tags=dict(self.span_tags, seq=self._seq, admits=n),
+        )
         return handle
 
     def tick_wait(self, handle: TickHandle) -> List[int]:
@@ -793,7 +814,12 @@ class SlotDecoder:
         (double-buffered dispatch admits into freed slots before the
         older tick is waited on; the admit-tick check also keeps slot
         indices within the handle's own bank shape across resizes)."""
+        t0 = time.monotonic()
         done_np = np.asarray(jax.device_get(handle.done))
+        self.tracer.record(
+            "tick_wait", t0, time.monotonic(),
+            tags=dict(self.span_tags, seq=handle.seq),
+        )
         return [
             s for s in self.occupied
             if self.admit_tick[s] <= handle.seq and bool(done_np[s])
@@ -833,6 +859,7 @@ class SlotDecoder:
         int32, score, steps), ...]`` in ``slots`` order."""
         if not slots:
             return []
+        t_harvest = time.monotonic()
         for s in slots:
             if s not in self.occupied:
                 raise RuntimeError(f"harvest of unoccupied slot {s}")
@@ -876,6 +903,10 @@ class SlotDecoder:
                 min(paid, self.L),
             ))
         self._zero_slots(list(slots))
+        self.tracer.record(
+            "harvest", t_harvest, time.monotonic(),
+            tags=dict(self.span_tags, seq=handle.seq, slots=len(slots)),
+        )
         return out
 
     def harvest(self, slot: int) -> Tuple[np.ndarray, float, int]:

@@ -755,7 +755,7 @@ def _make_split_step(model, cfg, scorer) -> Callable:
             isinstance(x, jax.Array) and len(x.sharding.device_set) > 1
         )
 
-    clock = PhaseClock()
+    clock = PhaseClock(tags={"layout": "split"})
     phase_ms: dict = {}
 
     def train_step(state, feats, feat_masks, captions, weights, category,
@@ -1197,7 +1197,7 @@ def _make_slot_step(model, cfg, scorer, layout: str) -> Callable:
             t *= 2
         return min(t, max_len)
 
-    clock = PhaseClock()
+    clock = PhaseClock(tags={"layout": layout})
     phase_ms: dict = {}
     last_stats: dict = {}
 

@@ -11,7 +11,7 @@ optimizer, so no Python-side LR mutation exists.
 from __future__ import annotations
 
 import time
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,24 +44,59 @@ class PhaseClock:
     up), so one step's phases always sum to ``total_ms`` minus unlapped
     gaps.  The dict written by ``commit`` is plain host floats — the
     trainer averages them into the epoch entry and TensorBoard.
+
+    Every lap is ALSO a span (observability/trace.py): ``lap(key)``
+    records the interval as ``phase/<key>`` and ``commit`` closes the
+    step's ``cst/step`` root, in the same Chrome-trace format the
+    serving /debug/trace export uses — so a CST step and a served
+    request render in one Perfetto timeline
+    (``train.trace_file`` writes the export at the end of fit()).
+    Clocks are ``time.monotonic()`` — the tracer's base, and the only
+    clock the CST-OBS rules allow on a span path.
     """
 
-    def __init__(self):
+    def __init__(self, tags: Optional[Dict[str, str]] = None,
+                 tracer=None):
+        if tracer is None:
+            from cst_captioning_tpu.observability.trace import get_tracer
+
+            tracer = get_tracer()
+        self.tracer = tracer
+        self.tags = dict(tags or ())
         self._t0 = None
         self._last = None
         self._acc: Dict[str, float] = {}
+        self._trace_id: Optional[str] = None
+        self._root_id: Optional[str] = None
 
     def start(self) -> None:
-        self._t0 = self._last = time.perf_counter()
+        self._t0 = self._last = time.monotonic()
         self._acc = {}
+        if self.tracer.enabled:
+            self._trace_id = self.tracer.new_trace_id()
+            self._root_id = self.tracer.new_span_id()
 
     def lap(self, key: str) -> None:
-        now = time.perf_counter()
+        now = time.monotonic()
         self._acc[key] = self._acc.get(key, 0.0) + (now - self._last) * 1e3
+        if self.tracer.enabled:
+            name = key[:-3] if key.endswith("_ms") else key
+            self.tracer.record(
+                f"phase/{name}", self._last, now,
+                trace_id=self._trace_id, parent_id=self._root_id,
+                tags=self.tags or None,
+            )
         self._last = now
 
     def commit(self, into: Dict[str, float]) -> Dict[str, float]:
-        total = (time.perf_counter() - self._t0) * 1e3
+        now = time.monotonic()
+        total = (now - self._t0) * 1e3
+        if self.tracer.enabled:
+            self.tracer.record(
+                "cst/step", self._t0, now,
+                trace_id=self._trace_id, span_id=self._root_id,
+                tags=self.tags or None,
+            )
         into.clear()
         into.update({k: round(v, 3) for k, v in self._acc.items()})
         into["total_ms"] = round(total, 3)

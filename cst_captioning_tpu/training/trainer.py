@@ -338,16 +338,19 @@ class Trainer:
         return extra
 
     def _profile_step(self, epoch: int, nsteps: int) -> None:
-        """jax.profiler trace of the first ~10 steps of the first epoch
-        (SURVEY.md §5 "Tracing / profiling" — absent in the reference);
-        closed at epoch end if the epoch is shorter."""
+        """jax.profiler trace of the first ``train.profile_window_steps``
+        steps of the first epoch (SURVEY.md §5 "Tracing / profiling" —
+        absent in the reference; the trainer-side twin of the serving
+        ``/debug/profile?ms=N`` window); closed at epoch end if the
+        epoch is shorter."""
         if epoch != 0 or self._profiling is None:
             return
+        window = max(1, int(self.cfg.train.profile_window_steps))
         if nsteps == 1 and not self._profiling:
             jax.profiler.start_trace(self.cfg.train.profile_dir)
             self._profiling = True
             log.info("profiler trace started -> %s", self.cfg.train.profile_dir)
-        elif nsteps == 11 and self._profiling:
+        elif nsteps == 1 + window and self._profiling:
             jax.profiler.stop_trace()
             self._profiling = None  # done for this run
             log.info("profiler trace written to %s", self.cfg.train.profile_dir)
@@ -607,4 +610,20 @@ class Trainer:
             ):
                 log.info("early stop at epoch %d", epoch)
                 break
+        self._export_trace()
         return self.history
+
+    def _export_trace(self) -> None:
+        """Write the span tracer's Chrome-trace JSON to
+        ``train.trace_file`` (PhaseClock phases are spans in the same
+        format the serving /debug/trace export uses — one Perfetto
+        timeline for a CST step and a served request).  Rank-0 only;
+        no-op with the knob unset."""
+        path = self.cfg.train.trace_file
+        if not path or jax.process_index() != 0:
+            return
+        from cst_captioning_tpu.observability.trace import get_tracer
+
+        with open(path, "w") as f:
+            f.write(get_tracer().export_json())
+        log.info("span trace written to %s (load in Perfetto)", path)

@@ -44,6 +44,7 @@ _FAMILY_OF_PREFIX = {
     "CST-DON": "donation",
     "CST-MET": "metrics_registry",
     "CST-SHD": "partitioning",
+    "CST-OBS": "observability",
 }
 
 

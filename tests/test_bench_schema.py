@@ -144,6 +144,28 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="bool-typed"):
             validate_record(rec)
 
+    def test_trace_overhead_fields_pass(self):
+        """ISSUE 10: paired tracing-on/off rows are numeric by
+        contract."""
+        rec = good_bench()
+        rec["extra"].update({
+            "trace_overhead_captions_per_sec_on": 553.3,
+            "trace_overhead_captions_per_sec_off": 583.7,
+            "trace_overhead_ratio": 0.948,
+            "trace_overhead_pct": 5.2,
+            "trace_overhead_p99_delta_ms": 6.6,
+            "trace_overhead_spans": 1003,
+            "trace_overhead_host_cores": 1.0,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "fast", [1.0]])
+    def test_non_numeric_trace_overhead_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["trace_overhead_ratio"] = bad
+        with pytest.raises(ValueError, match="trace_overhead_ratio"):
+            validate_record(rec)
+
     def test_mesh_shape_string_passes(self):
         """*_mesh_shape fields carry the topology a row ran on (ISSUE
         9): a "2x4"-style string in declared axis order."""

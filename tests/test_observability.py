@@ -1,0 +1,746 @@
+"""ISSUE 10: end-to-end span tracing, the crash flight recorder, and
+the Prometheus text-format audit.
+
+* tracer units: ids, nesting, bounded per-thread buffers, strictness,
+  disabled no-op, schema-valid Chrome-trace export;
+* tracer concurrency: spans from N worker threads interleave without
+  loss or cross-talk;
+* served-request e2e (beam, continuous batcher, 2 replicas + greedy,
+  single-replica): one trace_id links root -> queue -> admit -> decode
+  -> detok with consistent parent ids, the X-Trace-Id header echoes it,
+  /stats stamps it as the latency exemplar, /healthz//stats carry the
+  build fingerprint;
+* flight recorder: ring bounds, drain start/requeue/exit events
+  (shutdown satellite), watchdog dump, and fuzzed kill-mid-traffic
+  always yielding a schema-valid dump with the dead replica's ticks;
+* /metrics exposition pinned by a PARSER (HELP/TYPE per family,
+  registry-consistent types, correct content type) instead of
+  substring checks.
+"""
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config import get_preset
+from cst_captioning_tpu.data.vocab import Vocabulary
+from cst_captioning_tpu.observability.flight import (
+    FlightRecorder,
+    validate_flight_dump,
+)
+from cst_captioning_tpu.observability.trace import (
+    EVENT_CATALOGUE,
+    SPAN_CATALOGUE,
+    Tracer,
+    get_tracer,
+    registered,
+    validate_chrome_trace,
+)
+from cst_captioning_tpu.serving.metrics import (
+    METRIC_FAMILIES,
+    METRIC_HELP,
+    ServingMetrics,
+)
+
+# ------------------------------------------------------------ tracer units
+
+
+class TestTracer:
+    def test_record_and_export_schema(self):
+        t = Tracer()
+        sid = t.record("request", 1.0, 1.5, tags={"status": 200})
+        assert sid
+        obj = t.export_chrome_trace()
+        validate_chrome_trace(obj)
+        ev = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        assert len(ev) == 1
+        assert ev[0]["name"] == "request"
+        assert ev[0]["dur"] == pytest.approx(0.5e6)
+        assert ev[0]["args"]["status"] == 200
+
+    def test_span_nesting_links_parent_and_trace(self):
+        t = Tracer()
+        with t.span("request") as root:
+            with t.span("queue") as child:
+                pass
+        spans = {s["name"]: s for s in t.spans()}
+        assert spans["queue"]["parent_id"] == root.span_id
+        assert spans["queue"]["trace_id"] == root.trace_id
+        assert spans["request"]["parent_id"] is None
+        assert child.parent_id == root.span_id
+
+    def test_unregistered_name_raises(self):
+        t = Tracer()
+        with pytest.raises(ValueError, match="not registered"):
+            t.record("made_up_span", 0.0, 1.0)
+        with pytest.raises(ValueError, match="not registered"):
+            t.span("also_made_up")
+
+    def test_wildcard_families_match(self):
+        t = Tracer()
+        assert t.record("phase/dispatch", 0.0, 1.0)
+        assert registered("phase/score_wait")
+        assert not registered("phases/nope")
+
+    def test_buffers_are_bounded_per_thread(self):
+        t = Tracer(buffer_spans=8)
+        for _ in range(50):
+            t.record("tick_dispatch", 0.0, 0.1)
+        assert len(list(t.spans())) == 8
+
+    def test_disabled_tracer_is_noop(self):
+        t = Tracer(enabled=False)
+        assert t.record("request", 0.0, 1.0) is None
+        with t.span("request") as s:
+            assert s.span_id is None
+        assert list(t.spans()) == []
+
+    def test_clear(self):
+        t = Tracer()
+        t.record("harvest", 0.0, 1.0)
+        t.clear()
+        assert list(t.spans()) == []
+
+    def test_ids_are_unique(self):
+        t = Tracer()
+        ids = {t.new_trace_id() for _ in range(1000)}
+        ids |= {t.new_span_id() for _ in range(1000)}
+        assert len(ids) == 2000
+
+    def test_concurrent_emission_no_loss_no_crosstalk(self):
+        """Spans emitted from N worker threads + a 'batcher' thread
+        interleave without loss; each thread's spans stay on its own
+        exported tid (no cross-talk)."""
+        t = Tracer(buffer_spans=512)
+        N, per = 8, 50
+
+        def worker(i):
+            for k in range(per):
+                t.record(
+                    "tick_dispatch", k, k + 0.5,
+                    tags={"replica": i, "k": k},
+                )
+
+        threads = [
+            threading.Thread(target=worker, args=(i,), name=f"w{i}")
+            for i in range(N)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30.0)
+        obj = validate_chrome_trace(t.export_chrome_trace())
+        ev = [e for e in obj["traceEvents"] if e["ph"] == "X"]
+        assert len(ev) == N * per
+        by_replica = {}
+        for e in ev:
+            by_replica.setdefault(e["args"]["replica"], set()).add(
+                e["tid"]
+            )
+        for i in range(N):
+            # every span of worker i landed, on exactly one tid
+            assert len(by_replica[i]) == 1
+        ks = {
+            (e["args"]["replica"], e["args"]["k"]) for e in ev
+        }
+        assert len(ks) == N * per
+
+    def test_catalogue_entries_are_well_formed_and_unique(self):
+        names = [p for p, _, _ in SPAN_CATALOGUE + EVENT_CATALOGUE]
+        assert len(names) == len(set(names)), "duplicate family"
+        for pattern, component, help_text in SPAN_CATALOGUE + EVENT_CATALOGUE:
+            assert pattern and component and help_text
+
+
+class TestPhaseClockSpans:
+    def test_laps_become_spans_under_one_step_root(self):
+        from cst_captioning_tpu.training.steps import PhaseClock
+
+        tracer = Tracer()
+        clock = PhaseClock(tags={"layout": "split"}, tracer=tracer)
+        clock.start()
+        time.sleep(0.001)
+        clock.lap("dispatch_ms")
+        clock.lap("score_ms")
+        out = {}
+        clock.commit(out)
+        assert out["total_ms"] > 0
+        spans = {s["name"]: s for s in tracer.spans()}
+        assert {"phase/dispatch", "phase/score", "cst/step"} <= set(spans)
+        root = spans["cst/step"]
+        for name in ("phase/dispatch", "phase/score"):
+            assert spans[name]["parent_id"] == root["span_id"]
+            assert spans[name]["trace_id"] == root["trace_id"]
+            assert spans[name]["tags"]["layout"] == "split"
+        validate_chrome_trace(tracer.export_chrome_trace())
+
+    def test_each_step_is_its_own_trace(self):
+        from cst_captioning_tpu.training.steps import PhaseClock
+
+        tracer = Tracer()
+        clock = PhaseClock(tracer=tracer)
+        ids = set()
+        for _ in range(3):
+            clock.start()
+            clock.lap("update_ms")
+            clock.commit({})
+            ids = {s["trace_id"] for s in tracer.spans()}
+        assert len(ids) == 3
+
+
+# ------------------------------------------------------- flight recorder
+
+
+class TestFlightRecorder:
+    def test_ring_is_bounded_and_snapshot_validates(self):
+        fr = FlightRecorder("replica0", max_events=4)
+        for i in range(10):
+            fr.event("tick", seq=i)
+        snap = fr.snapshot()
+        validate_flight_dump(snap)
+        assert len(snap["events"]) == 4
+        assert snap["events"][-1]["tags"]["seq"] == 9
+
+    def test_unregistered_event_raises(self):
+        fr = FlightRecorder("x")
+        with pytest.raises(ValueError, match="not registered"):
+            fr.event("nope")
+
+    def test_dump_writes_schema_valid_json(self, tmp_path):
+        tracer = Tracer()
+        tracer.record("tick_dispatch", 0.0, 0.1, tags={"replica": 3})
+        tracer.record("tick_dispatch", 0.0, 0.1, tags={"replica": 4})
+        fr = FlightRecorder(
+            "replica3", out_dir=str(tmp_path), tracer=tracer,
+            tags={"replica": 3},
+        )
+        fr.event("tick", seq=1)
+        path = fr.dump("worker_death")
+        assert path is not None
+        body = validate_flight_dump(json.loads(open(path).read()))
+        assert body["reason"] == "worker_death"
+        assert "wall_time_utc" in body and "pid" in body
+        # only replica 3's spans ride along
+        assert body["spans"] and all(
+            s["tags"]["replica"] == 3 for s in body["spans"]
+        )
+
+    def test_dump_without_dir_is_noop(self):
+        fr = FlightRecorder("r")
+        fr.event("tick")
+        assert fr.dump("watchdog") is None
+
+
+# ---------------------------------------------- scheduler drain satellite
+
+# Stub engine/decoder pair mirroring tests/test_serving.py: the drain
+# semantics are scheduler-level, no jax needed.
+from test_serving import _StubSlotEngine  # noqa: E402
+
+
+class TestDrainFlightEvents:
+    def test_graceful_stop_records_drain_start_and_exit(self, tmp_path):
+        from cst_captioning_tpu.serving.batcher import ContinuousBatcher
+
+        eng = _StubSlotEngine(S=2)
+        eng.cfg.serving.flight_dir = str(tmp_path)
+        b = ContinuousBatcher(eng, ServingMetrics()).start()
+        b.submit({"steps": 2, "key": "k1"})
+        b.stop()
+        snap = b.flight_snapshot()["scheduler"]
+        validate_flight_dump(snap)
+        names = [e["event"] for e in snap["events"]]
+        assert "tick" in names
+        assert "drain_start" in names
+        assert "drain_exit" in names
+        assert names.index("drain_start") < names.index("drain_exit")
+        exit_ev = next(
+            e for e in snap["events"] if e["event"] == "drain_exit"
+        )
+        assert exit_ev["tags"]["served_all"] is True
+        # a completed drain leaves its post-mortem on disk too
+        dumps = list(tmp_path.glob("flight-scheduler-*-drain.json"))
+        assert len(dumps) == 1
+        validate_flight_dump(json.loads(dumps[0].read_text()))
+
+    def test_watchdog_deadline_dumps_flight(self, tmp_path):
+        from cst_captioning_tpu.serving.batcher import ContinuousBatcher
+
+        eng = _StubSlotEngine(S=1)
+        eng.cfg.serving.flight_dir = str(tmp_path)
+        b = ContinuousBatcher(
+            eng, ServingMetrics(), drain_timeout_s=0.3
+        ).start()
+        done = threading.Thread(
+            target=lambda: pytest.raises(
+                Exception, b.submit, {"steps": 10**9, "key": "never"}
+            )
+        )
+        done.start()
+        for _ in range(200):  # wait until the request occupies a slot
+            if eng.slot_decoder().n_occupied:
+                break
+            time.sleep(0.005)
+        b.stop()  # drain cannot finish -> watchdog
+        done.join(timeout=30.0)
+        snap = b.flight_snapshot()["scheduler"]
+        names = [e["event"] for e in snap["events"]]
+        assert "watchdog" in names
+        assert "dump" in names  # the dump itself is on the record
+        dumps = list(tmp_path.glob("flight-scheduler-*-watchdog.json"))
+        assert len(dumps) == 1
+        validate_flight_dump(json.loads(dumps[0].read_text()))
+
+
+# ----------------------------------------------------- served-request e2e
+
+
+def _tiny_cfg(mode="beam"):
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.decode_mode = mode
+    cfg.serving.max_batch_size = 2
+    cfg.serving.batch_shapes = [1, 2]
+    cfg.serving.num_slots = 3
+    cfg.eval.beam_size = 2
+    cfg.eval.max_decode_len = 8
+    cfg.data.max_frames = 4
+    cfg.serving.warmup = True
+    return cfg
+
+
+def _payload(seed):
+    rng = np.random.RandomState(seed)
+    return {
+        "features": {
+            "resnet": rng.randn(4, 64).astype(np.float32).tolist()
+        }
+    }
+
+
+def _post(url, obj, timeout=120.0):
+    req = urllib.request.Request(
+        url, data=json.dumps(obj).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return r.status, dict(r.headers), json.loads(r.read())
+
+
+def _get(url, timeout=30.0):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, dict(r.headers), r.read().decode()
+
+
+@pytest.fixture(scope="module")
+def replica_server():
+    """Beam decode, continuous batching, TWO replicas behind one door
+    (the acceptance shape)."""
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.server import CaptionServer
+
+    cfg = _tiny_cfg("beam")
+    cfg.serving.replicas = 2
+    vocab = Vocabulary([f"w{i}" for i in range(40)])
+    engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    with CaptionServer(engine, host="127.0.0.1", port=0) as srv:
+        yield srv
+
+
+@pytest.fixture(scope="module")
+def greedy_server(tmp_path_factory):
+    """Greedy decode, single-replica continuous batcher, profiling
+    endpoint armed."""
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.server import CaptionServer
+
+    cfg = _tiny_cfg("greedy")
+    cfg.serving.profile_dir = str(
+        tmp_path_factory.mktemp("profiles")
+    )
+    vocab = Vocabulary([f"w{i}" for i in range(40)])
+    engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    with CaptionServer(engine, host="127.0.0.1", port=0) as srv:
+        yield srv
+
+
+def _trace_spans(srv):
+    _, _, body = _get(srv.url + "/debug/trace")
+    obj = validate_chrome_trace(json.loads(body))
+    return [e for e in obj["traceEvents"] if e["ph"] == "X"]
+
+
+def _spans_for(events, trace_id):
+    return {
+        e["name"]: e for e in events
+        if e["args"]["trace_id"] == trace_id
+    }
+
+
+class TestServedRequestTimeline:
+    def test_beam_replicated_request_has_linked_span_chain(
+        self, replica_server
+    ):
+        srv = replica_server
+        tids = []
+        for seed in (1, 2, 3):
+            status, headers, out = _post(
+                srv.url + "/v1/caption", _payload(seed)
+            )
+            assert status == 200
+            assert "X-Trace-Id" in headers
+            tids.append(headers["X-Trace-Id"])
+        events = _trace_spans(srv)
+        for tid in tids:
+            spans = _spans_for(events, tid)
+            # the acceptance chain: root -> queue -> admit -> decode ->
+            # detok, all one trace, all parented on the root span.
+            assert {
+                "request", "queue", "admit", "decode", "detok"
+            } <= set(spans), sorted(spans)
+            root = spans["request"]
+            assert "parent_id" not in root["args"]
+            assert root["args"]["status"] == 200
+            for child in ("queue", "admit", "decode", "detok"):
+                assert spans[child]["args"]["parent_id"] == \
+                    root["args"]["span_id"]
+            # timeline sanity on the shared monotonic base
+            assert spans["queue"]["ts"] <= spans["admit"]["ts"]
+            assert spans["decode"]["ts"] <= spans["detok"]["ts"]
+            # the decode span names the replica that served it
+            assert spans["decode"]["args"]["replica"] in (0, 1)
+
+    def test_engine_timeline_has_tick_and_harvest_spans(
+        self, replica_server
+    ):
+        events = _trace_spans(replica_server)
+        names = {e["name"] for e in events}
+        assert {"tick_dispatch", "tick_wait", "harvest"} <= names
+        reps = {
+            e["args"].get("replica")
+            for e in events if e["name"] == "tick_dispatch"
+        }
+        # warmup ticks of the un-cloned front engine carry no replica
+        # tag; served traffic must have come from tagged replicas.
+        assert {0, 1} <= reps
+
+    def test_stats_exemplar_and_build_fingerprint(self, replica_server):
+        srv = replica_server
+        _, headers, out = _post(srv.url + "/v1/caption", _payload(7))
+        tid = headers["X-Trace-Id"]
+        _, _, body = _get(srv.url + "/stats")
+        stats = json.loads(body)
+        ex = stats["latency_ms"]["total"].get("exemplar")
+        assert ex is not None and ex["trace_id"] == tid
+        assert ex["value_ms"] >= 0
+        build = stats["build"]
+        assert build["params_tag"] == srv.engine.params_tag
+        assert build["mesh_shape"] == "1x1"
+        assert build["preset"] == "synthetic_smoke"
+        assert re.fullmatch(r"\d+\.\d+\.\d+", build["version"])
+        # /healthz carries the same block
+        _, _, hz = _get(srv.url + "/healthz")
+        assert json.loads(hz)["build"] == build
+
+    def test_debug_flight_live_view(self, replica_server):
+        _, _, body = _get(replica_server.url + "/debug/flight")
+        out = json.loads(body)
+        assert set(out["recorders"]) == {"replica0", "replica1"}
+        for snap in out["recorders"].values():
+            validate_flight_dump(snap)
+        assert "params_tag" in out["build"]
+        ticks = [
+            e for e in out["recorders"]["replica0"]["events"]
+            + out["recorders"]["replica1"]["events"]
+            if e["event"] == "tick"
+        ]
+        assert ticks  # traffic from the tests above left tick events
+
+    def test_greedy_request_traced_too(self, greedy_server):
+        srv = greedy_server
+        status, headers, _ = _post(srv.url + "/v1/caption", _payload(11))
+        assert status == 200
+        spans = _spans_for(_trace_spans(srv), headers["X-Trace-Id"])
+        assert {"request", "queue", "admit", "decode", "detok"} <= set(
+            spans
+        )
+
+    def test_error_response_still_closes_root_span(self, greedy_server):
+        srv = greedy_server
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _post(srv.url + "/v1/caption", {"feature_id": "ghost"})
+        assert ei.value.code == 404
+        tid = ei.value.headers["X-Trace-Id"]
+        spans = _spans_for(_trace_spans(srv), tid)
+        assert spans["request"]["args"]["status"] == 404
+
+
+class TestProfileEndpoint:
+    def test_profile_disabled_is_404(self, replica_server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(replica_server.url + "/debug/profile?ms=10")
+        assert ei.value.code == 404
+
+    def test_profile_window_runs_and_serializes(
+        self, greedy_server, monkeypatch
+    ):
+        import jax
+
+        calls = []
+        monkeypatch.setattr(
+            jax.profiler, "start_trace",
+            lambda d, **kw: calls.append(("start", d)),
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append(("stop",))
+        )
+        srv = greedy_server
+        status, _, body = _get(srv.url + "/debug/profile?ms=200")
+        assert status == 202
+        out = json.loads(body)
+        assert out["profiling_ms"] == 200
+        # a second window while one is running -> 409
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/debug/profile?ms=50")
+        assert ei.value.code == 409
+        for _ in range(100):
+            if ("stop",) in calls:
+                break
+            time.sleep(0.02)
+        assert calls[0] == ("start", srv._http.profile_dir)
+        assert ("stop",) in calls
+        # the window itself landed in the timeline
+        for _ in range(50):
+            names = {e["name"] for e in _trace_spans(srv)}
+            if "profile" in names:
+                break
+            time.sleep(0.02)
+        assert "profile" in names
+
+    def test_bad_window_is_400(self, greedy_server):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(greedy_server.url + "/debug/profile?ms=notanumber")
+        assert ei.value.code == 400
+
+
+# ------------------------------------------------- kill -> flight dump
+
+
+class TestKillReplicaFlightDump:
+    @pytest.mark.parametrize("seed", [0, 1, 2])
+    def test_fuzzed_kill_mid_traffic_yields_wellformed_dump(
+        self, tmp_path, seed
+    ):
+        """Acceptance: kill_replica mid-traffic writes a flight dump
+        containing that replica's last ticks — fuzzed over kill timing,
+        every dump schema-valid, no accepted request lost."""
+        from cst_captioning_tpu.serving.engine import InferenceEngine
+        from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+        cfg = _tiny_cfg("greedy")
+        cfg.serving.replicas = 2
+        cfg.serving.flight_dir = str(tmp_path)
+        vocab = Vocabulary([f"w{i}" for i in range(40)])
+        engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+        rs = ReplicaSet.from_engine(engine, ServingMetrics()).start()
+        rng = np.random.RandomState(seed)
+        errors, served = [], []
+        lock = threading.Lock()
+
+        def client(cid):
+            for k in range(4):
+                try:
+                    rs.submit(
+                        _payload(1000 + seed * 100 + cid * 10 + k),
+                        deadline_ms=120_000.0,
+                    )
+                    with lock:
+                        served.append(cid)
+                except Exception as e:  # noqa: BLE001
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+        threads = [
+            threading.Thread(target=client, args=(c,)) for c in range(3)
+        ]
+        for t in threads:
+            t.start()
+        time.sleep(float(rng.uniform(0.01, 0.25)))
+        rs.kill_replica(0)
+        for t in threads:
+            t.join(timeout=120.0)
+        rs.stop()
+        assert not errors, errors
+        assert len(served) == 12  # zero-drop: survivors absorbed it all
+        dumps = list(tmp_path.glob("flight-replica0-*.json"))
+        assert dumps, "kill_replica produced no flight dump"
+        for p in dumps:
+            body = validate_flight_dump(json.loads(p.read_text()))
+            names = [e["event"] for e in body["events"]]
+            assert "kill" in names
+            assert "drain_requeue" in names
+            assert body["tags"] == {"replica": 0}
+        # the dead replica's last ticks are in at least one dump
+        all_events = [
+            e
+            for p in dumps
+            for e in json.loads(p.read_text())["events"]
+        ]
+        assert any(e["event"] == "tick" for e in all_events)
+
+
+# ------------------------------------- Prometheus text-format audit
+
+
+def _parse_prometheus(text):
+    """Minimal text-format parser: returns ({name: help}, {name: type},
+    [(name, labels, value)]); raises AssertionError on malformed lines
+    or samples emitted before their family header."""
+    helps, types, samples = {}, {}, []
+    announced = set()
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            name, _, help_text = line[len("# HELP "):].partition(" ")
+            assert help_text.strip(), f"empty HELP for {name}"
+            helps[name] = help_text
+        elif line.startswith("# TYPE "):
+            parts = line[len("# TYPE "):].split(" ")
+            assert len(parts) == 2, f"malformed TYPE line: {line}"
+            name, typ = parts
+            assert typ in (
+                "counter", "gauge", "histogram", "summary", "untyped"
+            )
+            assert name in helps, f"TYPE before HELP for {name}"
+            types[name] = typ
+            announced.add(name)
+        elif line.startswith("#"):
+            continue
+        else:
+            m = re.fullmatch(
+                r"([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^{}]*\})?\s+(\S+)",
+                line,
+            )
+            assert m, f"malformed sample line: {line!r}"
+            name, labels, value = m.groups()
+            float(value)  # must parse
+            base = name
+            for suffix in ("_bucket", "_count", "_sum"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+                    break
+            assert (
+                name in announced or base in announced
+            ), f"sample {name} has no preceding HELP/TYPE"
+            samples.append((name, labels, value))
+    return helps, types, samples
+
+
+class TestPrometheusExposition:
+    def test_exposition_parses_and_every_family_is_typed(
+        self, replica_server
+    ):
+        from fnmatch import fnmatchcase
+
+        status, headers, text = _get(replica_server.url + "/metrics")
+        assert status == 200
+        assert headers["Content-Type"] == (
+            "text/plain; version=0.0.4; charset=utf-8"
+        )
+        helps, types, samples = _parse_prometheus(text)
+        assert samples
+        registry = dict(METRIC_FAMILIES)
+
+        def family_of(name):
+            base = name
+            for suffix in ("_bucket", "_count", "_sum"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+            if base in registry:
+                return base, registry[base]
+            for pat, typ in METRIC_FAMILIES:
+                if fnmatchcase(base, pat):
+                    return pat, typ
+            raise AssertionError(f"sample {name} matches no family")
+
+        for name, _labels, _v in samples:
+            fam, typ = family_of(name)
+            base = name
+            for suffix in ("_bucket", "_count", "_sum"):
+                if name.endswith(suffix):
+                    base = name[: -len(suffix)]
+            assert types[base] == typ, (
+                f"{name}: exposed type {types[base]} != registered "
+                f"{typ} (family {fam})"
+            )
+
+    def test_every_registered_family_has_help_text(self):
+        for pattern, _typ in METRIC_FAMILIES:
+            assert pattern in METRIC_HELP, (
+                f"family {pattern} has no HELP text — add it to "
+                "serving/metrics.py::METRIC_HELP"
+            )
+            assert METRIC_HELP[pattern].strip()
+
+    def test_histogram_buckets_are_cumulative(self, replica_server):
+        _, _, text = _get(replica_server.url + "/metrics")
+        buckets = {}
+        for line in text.splitlines():
+            m = re.fullmatch(
+                r"(caption_latency_total_ms)_bucket\{le=\"([^\"]+)\"\}"
+                r"\s+(\d+)",
+                line,
+            )
+            if m:
+                buckets[m.group(2)] = int(m.group(3))
+        assert buckets and "+Inf" in buckets
+        vals = list(buckets.values())
+        assert vals == sorted(vals)
+        counts = re.findall(
+            r"caption_latency_total_ms_count (\d+)", text
+        )
+        assert int(counts[0]) == buckets["+Inf"]
+
+
+# --------------------------------------------- analysis vacuous-green guard
+
+
+class TestObsCheckerSeesRealSites:
+    def test_emission_sites_discovered_in_serving_and_training(self):
+        from pathlib import Path
+
+        from cst_captioning_tpu.analysis.astutil import scan_package
+        from cst_captioning_tpu.analysis.observability import (
+            emission_sites,
+        )
+
+        root = Path(
+            __file__
+        ).resolve().parent.parent / "cst_captioning_tpu"
+        mods = [
+            m for m in scan_package(root)
+            if not m.rel.startswith("analysis/")
+        ]
+        sites = emission_sites(mods)
+        by_file = {}
+        for mi, node in sites:
+            by_file.setdefault(mi.rel, 0)
+            by_file[mi.rel] += 1
+        for rel in (
+            "serving/slots.py",
+            "serving/batcher.py",
+            "serving/replicas.py",
+            "serving/server.py",
+            "training/steps.py",
+        ):
+            assert by_file.get(rel, 0) >= 1, (
+                f"CST-OBS checker sees no emission sites in {rel} — "
+                "the rule went vacuously green"
+            )
+        assert by_file["serving/slots.py"] >= 3  # dispatch/wait/harvest
