@@ -46,7 +46,10 @@ BENCH_SHARD=0 to skip the paired replicated-vs-model-sharded XE rows
 (subprocess virtual-CPU child; BENCH_SHARD_N / _BATCH / _VOCAB /
 _STEPS size it), BENCH_TRACE=0 to skip the paired tracing-on/off
 serving rows (subprocess CPU child; BENCH_TRACE_REQS / _CLIENTS /
-_REPS size it),
+_REPS size it), BENCH_SLO=0 to skip the chaos-soak/SLO-attainment
+rows (subprocess CPU child; BENCH_SLO_SEED / _REQS size it — the
+slo_reference_attainment row feeds the SLO regression gate, which
+exits 3 on a pinned-threshold breach),
 BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -152,6 +155,21 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
             ):
                 fail(
                     f"{k!r} must be a positive core count, got {v!r}"
+                )
+        # SLO soak rows (ISSUE 11): every slo_* field is a measurement
+        # by contract — numeric, never bool/None/prose — and attainment
+        # fields are FRACTIONS in [0, 1] (the SLO gate compares them
+        # against the pinned threshold; a value outside the unit
+        # interval means the soak mis-counted).
+        for k, v in rec["extra"].items():
+            if not k.startswith("slo_"):
+                continue
+            if not _is_number(v):
+                fail(f"{k!r} must be a real number, got {v!r}")
+            if "attainment" in k and not (0.0 <= v <= 1.0):
+                fail(
+                    f"{k!r} must be an attainment fraction in [0, 1], "
+                    f"got {v!r}"
                 )
         # Mesh topology is a machine-readable string by contract
         # (ISSUE 9): any *_mesh_shape field must look like "2x4" —
@@ -1533,6 +1551,212 @@ def bench_trace_overhead():
     return json.loads(lines[-1])
 
 
+# --------------------------------------------------------- SLO gate
+#
+# The chaos-soak rows (ISSUE 11) turn the bench from a speedometer into
+# a survival certificate: slo_reference_attainment is the fraction of
+# recorded-trace requests a healthy fleet served under deadline at the
+# reference load.  A change that drops it below the pinned threshold
+# fails the WHOLE bench run loudly (exit 3, named reason) — the SLO
+# regression gate.
+SLO_GATE_METRIC = "slo_reference_attainment"
+# The pinned threshold; BENCH_SLO_GATE_MIN overrides it so the failure
+# path is demonstrable from the shell (set it above the measured
+# attainment and the run exits 3 with the named reason).
+SLO_GATE_MIN = float(os.environ.get("BENCH_SLO_GATE_MIN", "0.9"))
+
+
+def bench_exit_code(measured: bool, errors: dict) -> int:
+    """The bench process's exit-code contract: 3 = the SLO regression
+    gate tripped (a named, dedicated failure — it outranks 'something
+    was measured'), 0 = at least one metric landed, 1 = nothing at
+    all was measured."""
+    if "slo_gate" in errors:
+        return 3
+    return 0 if measured else 1
+
+
+def slo_gate(extra: dict):
+    """Evaluate the SLO regression gate over an emitted extras dict.
+    Returns None when the gate passes (or the soak didn't run), else
+    the named failure reason the driver surfaces."""
+    v = extra.get(SLO_GATE_METRIC)
+    if v is None:
+        return None
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return (
+            f"slo_regression: {SLO_GATE_METRIC} is non-numeric "
+            f"({v!r}) — the soak mis-reported"
+        )
+    if v < SLO_GATE_MIN:
+        return (
+            f"slo_regression: {SLO_GATE_METRIC}={v:.3f} fell below the "
+            f"pinned threshold {SLO_GATE_MIN} at reference load"
+        )
+    return None
+
+
+def _bench_slo_impl():
+    """Chaos soak + SLO-attainment rows (ISSUE 11): replay recorded
+    arrival traces against a real 2-replica ``ReplicaSet`` through the
+    virtual-time soak harness (serving/chaos.py::run_soak — the
+    single-threaded drive that makes every shed/requeue/expiry decision
+    deterministic in the chaos seed).
+
+    Scenarios:
+
+    * **reference** — steady load a healthy fleet sustains; its
+      attainment is the SLO gate's input (``slo_reference_attainment``).
+    * **chaos** — a diurnal burst trace with mid-traffic chaos (one
+      replica kill + periodic tick stalls + queue bursts + cache-miss
+      storms + deadline-adjacent arrivals) at overload: per-priority
+      attainment shows the degradation ladder holding (interactive >=
+      best-effort), with zero lost requests.  The chaos scenario replays
+      TWICE with the same seed; ``slo_replay_mismatches`` counts
+      decision-log divergences (0 = deterministic, the acceptance bar).
+
+    Env: BENCH_SLO_SEED (default 1123), BENCH_SLO_REQS (requests per
+    scenario, default 60)."""
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.serving.chaos import (
+        ChaosEngine,
+        make_diurnal_trace,
+        run_soak,
+    )
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+    from cst_captioning_tpu.serving.metrics import ServingMetrics
+    from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+    seed = int(os.environ.get("BENCH_SLO_SEED", "1123"))
+    n_reqs = int(os.environ.get("BENCH_SLO_REQS", "60"))
+
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.num_slots = 4
+    vocab = Vocabulary([f"w{i}" for i in range(252)])
+    cfg.model.vocab_size = len(vocab)
+    engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    dev = jax.devices()[0]
+    clones = [
+        engine.clone_for_device(dev, replica_id=i) for i in range(2)
+    ]
+
+    rng = np.random.RandomState(seed)
+    F = cfg.data.max_frames
+    n_keys = 24
+    payloads = [
+        {
+            "features": {
+                m: rng.randn(F, d).astype(np.float32).tolist()
+                for m, d in cfg.data.feature_dims.items()
+            }
+        }
+        for _ in range(n_keys)
+    ]
+
+    def fresh_rs(queue_depth):
+        for c in clones:
+            c.cache.captions.clear()
+        return ReplicaSet(
+            clones, ServingMetrics(), queue_depth=queue_depth,
+        )
+
+    # ---- reference scenario: healthy fleet, sustainable steady load
+    ref_trace = make_diurnal_trace(
+        seed, n_reqs, n_keys, base_per_tick=0.5, burst_factor=1.0,
+    )
+    ref_slo_ticks = 60
+    rs = fresh_rs(queue_depth=256)
+    t0 = time.perf_counter()
+    ref = run_soak(rs, payloads, ref_trace)
+    ref_wall = time.perf_counter() - t0
+    ref_att = ref.attainment(ref_slo_ticks)
+
+    # ---- chaos scenario: diurnal burst + mid-traffic chaos, overload
+    chaos_schedule = [
+        {"site": "replica_kill", "at": 8, "replica": 0},
+        {"site": "tick_stall", "every": 5, "replica": 1, "value": 0.02},
+        {"site": "queue_burst", "every": 7, "value": 4},
+        {"site": "cache_miss", "p": 0.2},
+        {"site": "deadline_skew", "every": 17, "value": 0.0},
+    ]
+    chaos_trace = make_diurnal_trace(
+        seed + 1, n_reqs, n_keys, base_per_tick=1.0, burst_factor=6.0,
+    )
+    chaos_slo_ticks = 40
+
+    def chaos_run():
+        rs = fresh_rs(queue_depth=6)
+        ce = ChaosEngine(seed=seed, schedule=chaos_schedule)
+        rep = run_soak(rs, payloads, chaos_trace, chaos=ce)
+        return rep
+
+    r1 = chaos_run()
+    r2 = chaos_run()
+    mismatches = sum(
+        1 for a, b in zip(r1.decisions, r2.decisions) if a != b
+    ) + abs(len(r1.decisions) - len(r2.decisions)) + sum(
+        1 for a, b in zip(r1.chaos_log, r2.chaos_log) if a != b
+    ) + abs(len(r1.chaos_log) - len(r2.chaos_log))
+    att = r1.attainment(chaos_slo_ticks)
+
+    return {
+        "chaos_soak_shape": "smoke",
+        "slo_host_cores": float(os.cpu_count() or 1),
+        "slo_chaos_seed": float(seed),
+        "slo_requests": float(n_reqs),
+        "slo_reference_attainment": round(ref_att["overall"], 4),
+        "slo_reference_ticks": float(ref.ticks),
+        "slo_reference_wall_s": round(ref_wall, 2),
+        "slo_reference_lost": float(ref.lost),
+        "slo_chaos_attainment_overall": round(att["overall"], 4),
+        "slo_chaos_attainment_interactive": round(
+            att.get("interactive", 0.0), 4
+        ),
+        "slo_chaos_attainment_batch": round(att.get("batch", 0.0), 4),
+        "slo_chaos_attainment_best_effort": round(
+            att.get("best_effort", 0.0), 4
+        ),
+        "slo_chaos_lost": float(r1.lost),
+        "slo_chaos_kills": float(r1.kills),
+        "slo_chaos_stall_ticks": float(r1.stall_ticks),
+        "slo_chaos_served": float(r1.served),
+        "slo_chaos_shed": float(r1.count("shed")),
+        "slo_chaos_expired": float(r1.count("expired")),
+        "slo_chaos_faults_fired": float(len(r1.chaos_log)),
+        "slo_replay_mismatches": float(mismatches),
+    }
+
+
+def bench_slo():
+    """Chaos soak + SLO rows (see :func:`_bench_slo_impl`).  Re-execs
+    into a CPU subprocess (the bench_trace_overhead precedent): the
+    soak targets the smoke shape and must not disturb the TPU-held
+    parent."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SLO_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=900, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"slo soak child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    return json.loads(lines[-1])
+
+
 def _bench_slot_mem_impl():
     """Paired REPLICATED-vs-DEDUPED decode-state memory rows (ISSUE 7).
 
@@ -2643,6 +2867,20 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["trace_bench_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_SLO", "1") == "1":
+        # Chaos soak + SLO-attainment rows (ISSUE 11): recorded-trace
+        # replay against a 2-replica set with mid-traffic chaos, in a
+        # CPU subprocess (degraded-mode safe).  The reference-load
+        # attainment feeds the SLO regression gate below.
+        try:
+            extra.update(bench_slo())
+        except Exception as e:  # noqa: BLE001
+            extra["slo_error"] = f"{type(e).__name__}: {e}"
+        gate_reason = slo_gate(extra)
+        if gate_reason is not None:
+            errors["slo_gate"] = gate_reason
+            print(f"SLO GATE FAILED: {gate_reason}", file=sys.stderr)
+        emit()
     if os.environ.get("BENCH_SHARD", "1") == "1":
         # Paired replicated-vs-model-sharded XE rows on a >=4-device
         # mesh (ISSUE 9): inline on multi-device hosts, re-exec'd onto
@@ -2700,7 +2938,11 @@ def main() -> int:
         and k not in diagnostic
         for k, v in extra.items()
     )
-    return 0 if measured else 1
+    # The SLO regression gate (ISSUE 11) fails the run LOUDLY even when
+    # everything else measured fine: a fleet that stopped meeting its
+    # latency contract at reference load must not land quietly in the
+    # artifact trail.  Exit 3 is the gate's dedicated, named code.
+    return bench_exit_code(measured, errors)
 
 
 if __name__ == "__main__":
@@ -2721,6 +2963,11 @@ if __name__ == "__main__":
         # (bench_slot_mem).
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_slot_mem_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_SLO_CHILD") == "1":
+        # Re-exec'd chaos-soak/SLO child (bench_slo).
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_slo_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_TRACE_CHILD") == "1":
         # Re-exec'd tracing-on/off serving child (bench_trace_overhead).

@@ -98,6 +98,7 @@ def _load_checkers() -> None:
         metrics_registry,
         observability,
         partitioning,
+        resilience,
         single_site,
         thread_safety,
     )

@@ -358,6 +358,31 @@ class ServingConfig:
     # jax.profiler device-trace output dir for the opt-in
     # GET /debug/profile?ms=N window.  "" disables the endpoint.
     profile_dir: str = ""
+    # Request hedging (serving/replicas.py): when a submitted request
+    # has produced no result after max(hedge_ms, measured p99 of the
+    # total-latency histogram) milliseconds, dispatch a duplicate onto a
+    # second healthy replica — first result wins, the losing copy is
+    # cancelled at admission (queued) or discarded at harvest
+    # (in-flight).  Token-exact by construction: every replica holds
+    # byte-identical weights, so either copy decodes the same caption
+    # (pinned in tests/test_replicas.py).  0 = hedging off (default; the
+    # serve path is byte-identical to the pre-hedging scheduler).
+    hedge_ms: float = 0.0
+    # Server-side retry budget: how many times a request may be requeued
+    # onto survivors across replica deaths before it fails outright —
+    # caps the requeue storm a flapping fleet could otherwise amplify
+    # (`caption_requeue_overflow_total` counts the cap firing).
+    requeue_budget: int = 3
+    # Deterministic fault injection (serving/chaos.py).  Empty dict =
+    # chaos fully OFF: no ChaosEngine is constructed and the serving
+    # path is byte-identical to a chaos-free build (pinned by the
+    # no-chaos parity test).  Keys: "seed" (int), "schedule" (list of
+    # entries {"site": <FAULT_SITES name>, "at"|"every"|"p": trigger,
+    # "replica": optional id, "value": site-specific payload}).  Every
+    # site is catalogued in serving/chaos.py::FAULT_SITES and documented
+    # in docs/SERVING.md; the CST-RES analysis rules machine-check the
+    # call sites.
+    chaos: Dict[str, Any] = field(default_factory=dict)
     # Tier-2 byte budget (0 = entry-count bound only).  Projected
     # DecodeCache rows are the largest cached objects — bound the tier
     # by what it actually holds, not how many entries it has; evictions

@@ -112,6 +112,16 @@ EVENT_CATALOGUE: List[Tuple[str, str, str]] = [
      "the drain/watchdog deadline expired with work still in flight"),
     ("dump", "flight",
      "a flight dump was written to disk (path + reason)"),
+    ("shed", "flight",
+     "a request was load-shed (priority eviction under overload, "
+     "deadline expiry, or requeue-budget overflow); also recorded as a "
+     "zero-length span on the request's trace when it carries one"),
+    ("hedge", "flight",
+     "a hedged duplicate of a slow request was dispatched onto a second "
+     "healthy replica (first result wins, loser cancelled)"),
+    ("chaos_fault", "flight",
+     "a ChaosEngine injection fired at a registered FAULT_SITES site "
+     "(serving/chaos.py; site + parameters in the tags)"),
 ]
 
 _ALL_PATTERNS = [p for p, _, _ in SPAN_CATALOGUE + EVENT_CATALOGUE]

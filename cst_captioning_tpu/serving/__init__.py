@@ -28,6 +28,15 @@ fixed-shape discipline as training:
   double-buffered tick dispatch per worker and unhealthy-replica
   drain/requeue (``serving.replicas``; the default scheduler when
   ``replicas != 1``).
+* ``chaos``   — deterministic fault injection + recorded-trace soak:
+  a seeded, schedule-driven ``ChaosEngine`` consulted at the
+  registered ``FAULT_SITES`` (replica kill, tick stall, queue burst,
+  cache-miss storm, deadline skew — off by default, byte-identical
+  serving when off) and ``run_soak``, the virtual-time replay harness
+  behind bench.py's ``slo_*`` rows and the SLO regression gate.
+  Priorities + deadline-aware shedding, hedging, computed Retry-After
+  and the requeue budget live in ``batcher``/``replicas`` (see
+  docs/SERVING.md "Failure modes & degradation ladder").
 * ``cache``   — two-tier LRU: content-hash -> decoded caption, and
   feature-id -> projected encoder state (skips the encode GEMMs on the
   scan beam path via ``decoding.beam.beam_search_from_state``).
@@ -57,6 +66,14 @@ from cst_captioning_tpu.serving.batcher import (  # noqa: F401
     ShuttingDownError,
 )
 from cst_captioning_tpu.serving.cache import LRUCache, TwoTierCache  # noqa: F401
+from cst_captioning_tpu.serving.chaos import (  # noqa: F401
+    FAULT_SITES,
+    ChaosEngine,
+    RecordedRequest,
+    SoakReport,
+    make_diurnal_trace,
+    run_soak,
+)
 from cst_captioning_tpu.serving.engine import InferenceEngine  # noqa: F401
 from cst_captioning_tpu.serving.metrics import (  # noqa: F401
     Gauge,

@@ -20,19 +20,38 @@ under one of two disciplines:
 
 Shared semantics (both disciplines, pinned by tests):
 
+* **Priorities + deadline-aware shedding** (ISSUE 11): every request
+  carries a ``priority`` class (``interactive`` > ``batch`` >
+  ``best_effort``; default ``interactive``).  When the bounded queue is
+  full, an arriving request EVICTS the oldest strictly-lower-priority
+  queued request instead of being refused — the victim's submitter gets
+  :class:`BackpressureError` (HTTP 429), the decision lands on
+  ``caption_shed_total{priority}``, a ``shed`` flight event, and a
+  zero-length ``shed`` span on the victim's trace.  Within one priority
+  class nothing accepted is ever dropped (the original zero-drop
+  contract, now scoped per class).
 * **Deadlines + cancellation**: every request carries an absolute
   deadline (``default_deadline_ms`` unless the client set one).  A
-  request that expires while queued is dropped BEFORE it wastes device
+  request that expires while queued is SHED before it wastes device
   work; its submitter gets :class:`DeadlineExceededError`.
-* **Backpressure**: when the queue is full, ``submit`` fails fast with
-  :class:`BackpressureError` carrying a retry-after hint — the HTTP
-  layer maps it to 429 + ``Retry-After``.  Nothing non-expired that was
-  ACCEPTED is ever dropped (the zero-drop contract in the tier-1 load
-  test).
+* **Backpressure with honest retry hints**: queue-full rejects and
+  503/draining responses carry a ``Retry-After`` computed from the live
+  queue depth plus a deterministic per-request jitter
+  (:meth:`_BatcherBase.retry_after`) — never a constant, so a
+  synchronized client retry storm cannot re-overload a recovering
+  fleet.
 * **Graceful drain**: ``stop()`` (and SIGTERM via the server) stops
   admissions — new submits raise :class:`ShuttingDownError` (HTTP 503)
   — then lets queued + in-flight work finish within
   ``drain_timeout_s`` before failing whatever remains.
+
+Fault injection (ISSUE 11): when ``serving.chaos`` is configured, a
+:class:`~cst_captioning_tpu.serving.chaos.ChaosEngine` is consulted at
+the registered FAULT_SITES (cache-miss storms and deadline skew at
+submit, queue bursts and tick stalls in the scheduler loop; replica
+kills live in serving/replicas.py).  With the default empty config the
+engine is ``None`` and every site short-circuits — byte-identical
+serving, pinned by the no-chaos parity test.
 
 Tier-1 cache hits short-circuit in ``submit`` — an identical request
 returns without touching the queue or the device.
@@ -43,15 +62,41 @@ from __future__ import annotations
 import logging
 import threading
 import time
+import zlib
 from collections import deque
-from typing import Any, Deque, Dict, List, Optional
+from concurrent.futures import InvalidStateError
+from typing import Any, Deque, Dict, List, Optional, Union
 
 from cst_captioning_tpu.observability.flight import FlightRecorder
 from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
+from cst_captioning_tpu.serving.chaos import ChaosEngine
 from cst_captioning_tpu.serving.engine import InferenceEngine
-from cst_captioning_tpu.serving.metrics import ServingMetrics
+from cst_captioning_tpu.serving.metrics import PRIORITIES, ServingMetrics
 
 _log = logging.getLogger("cst_captioning_tpu.serving")
+
+# Priority rank: higher = more valuable = shed LAST.  The vocabulary is
+# closed (metrics label values) — an unknown class is a 400, not a new
+# label series.
+PRIORITY_RANK = {p: r for r, p in enumerate(reversed(PRIORITIES))}
+
+
+def _settle_result(pending: "_Pending", result: Dict[str, Any]) -> bool:
+    """Resolve a future exactly once (hedged requests race two workers
+    onto the same future — first result wins, losers report False)."""
+    try:
+        pending.future.set_result(result)
+        return True
+    except InvalidStateError:
+        return False
+
+
+def _settle_exception(pending: "_Pending", exc: BaseException) -> bool:
+    try:
+        pending.future.set_exception(exc)
+        return True
+    except InvalidStateError:
+        return False
 
 
 class BackpressureError(Exception):
@@ -69,23 +114,36 @@ class DeadlineExceededError(Exception):
 
 
 class ShuttingDownError(Exception):
-    """The server is draining — no new requests are admitted (503)."""
+    """The server is draining — no new requests are admitted (503).
+    Carries an optional queue-depth-derived ``retry_after_s`` hint the
+    HTTP layer exposes as a ``Retry-After`` header."""
+
+    def __init__(self, msg: str, retry_after_s: Optional[float] = None):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
 
 
 class _Pending:
     # Single-owner contract (checked by the CST-THR analysis rules): a
     # _Pending belongs to exactly one scheduler thread at any moment —
     # it is handed between queues only under the batcher/replica-set
-    # _cond, and the owning worker alone writes t_admit.  The
-    # submitter's only touchpoint is the (internally synchronized)
-    # Future.
+    # _cond (including hedge copies, requeues, and shed eviction), and
+    # the owning worker alone writes t_admit.  A HEDGED pending is the
+    # one sanctioned exception: two workers may decode it concurrently,
+    # but their only shared writes are the internally-synchronized
+    # Future (first-result-wins via _settle_*) and the timing-metadata
+    # t_admit, whose raced value only skews one latency observation.
     _analysis_single_owner = True
 
     __slots__ = (
         "prepared", "future", "t_enqueue", "t_admit", "deadline", "trace",
+        "priority", "rid", "requeues", "hedged",
     )
 
-    def __init__(self, prepared, deadline: float, trace=None):
+    def __init__(
+        self, prepared, deadline: float, trace=None,
+        priority: str = "interactive",
+    ):
         from concurrent.futures import Future
 
         self.prepared = prepared
@@ -97,6 +155,10 @@ class _Pending:
         # written once here; the scheduler parents its queue/admit/
         # decode/detok spans under it (observability/trace.py).
         self.trace = trace
+        self.priority = priority
+        self.rid = -1        # primary replica id (ReplicaSet routing)
+        self.requeues = 0    # times requeued after a replica drain
+        self.hedged = False  # a duplicate copy was dispatched
 
 
 class _BatcherBase:
@@ -154,6 +216,14 @@ class _BatcherBase:
             out_dir=str(getattr(sv, "flight_dir", "") or ""),
             tracer=self.tracer,
         )
+        # Fault injection (ISSUE 11): None unless serving.chaos is
+        # configured — every injection site below is guarded on this, so
+        # the default path is byte-identical to a chaos-free build
+        # (CST-RES-002).
+        self.chaos = ChaosEngine.from_config(sv)
+        # Monotonic per-reject sequence: the deterministic jitter key
+        # for requests without a content hash (incremented under _cond).
+        self._retry_seq = 0
 
     def _flight_name(self) -> str:
         return "scheduler"
@@ -220,11 +290,9 @@ class _BatcherBase:
         with self._cond:
             self._thread = None
             while self._q:
-                p = self._q.popleft()
-                if not p.future.done():
-                    p.future.set_exception(
-                        RuntimeError("batcher stopped")
-                    )
+                _settle_exception(
+                    self._q.popleft(), RuntimeError("batcher stopped")
+                )
 
     def __enter__(self):
         return self.start()
@@ -242,44 +310,152 @@ class _BatcherBase:
         multi-worker subclasses)."""
         return self._thread is not None
 
+    # ------------------------------------------------- retry hints / shed
+    def _depth_locked(self) -> int:
+        """Queued requests right now (called under ``self._cond``)."""
+        return len(self._q)
+
+    def _jitter_key(self, pending: Optional["_Pending"]) -> str:
+        """Deterministic per-request jitter key: the content hash when
+        the request has one, else a monotone reject sequence (called
+        under ``self._cond``)."""
+        key = getattr(
+            getattr(pending, "prepared", None), "cache_key", ""
+        ) if pending is not None else ""
+        if not key:
+            self._retry_seq += 1
+            key = f"seq{self._retry_seq}"
+        return key
+
+    def _retry_after_value(self, depth: int, key: Optional[str]) -> float:
+        """Queue-depth-derived retry hint (ISSUE 11 satellite): scales
+        with how full the queue is, plus a deterministic per-request
+        jitter so synchronized clients don't all come back in the same
+        instant and re-overload a recovering replica."""
+        base = self.retry_after_s
+        frac = min(depth / float(max(1, self.queue_depth)), 2.0)
+        val = base * (0.25 + frac)
+        if key:
+            val += base * 0.5 * (
+                (zlib.crc32(str(key).encode("utf-8", "ignore")) % 1024)
+                / 1024.0
+            )
+        return round(val, 4)
+
+    def retry_after(self, key: Optional[str] = None) -> float:
+        """Public retry hint for the HTTP layer's 503 paths."""
+        with self._cond:
+            depth = self._depth_locked()
+            if key is None:
+                key = self._jitter_key(None)
+        return self._retry_after_value(depth, key)
+
+    def _shed_one(
+        self, victim: "_Pending", depth: int, flight=None,
+        reason: str = "priority_evict",
+    ) -> None:
+        """Fail one shed victim: 429 + computed Retry-After to its
+        submitter, `caption_shed_total{priority}`, a flight event, and a
+        zero-length `shed` span on its trace."""
+        self.metrics.shed(victim.priority).inc()
+        recorder = flight if flight is not None else self.flight
+        recorder.event("shed", priority=victim.priority, reason=reason)
+        if victim.trace is not None:
+            t = time.monotonic()
+            self.tracer.record(
+                "shed", t, t,
+                trace_id=victim.trace[0], parent_id=victim.trace[1],
+                tags={"priority": victim.priority, "reason": reason},
+            )
+        _settle_exception(
+            victim,
+            BackpressureError(
+                self._retry_after_value(depth, self._jitter_key(victim))
+            ),
+        )
+
+    def _shed_lower_priority(self, incoming: "_Pending") -> bool:
+        """Queue-full overload: evict the oldest queued request of the
+        LOWEST priority class strictly below ``incoming``'s (called
+        under ``self._cond``).  Returns False when nothing below it is
+        queued — the incoming request is then itself the shed decision
+        (rejected by the caller)."""
+        rank = PRIORITY_RANK[incoming.priority]
+        victim = None
+        for p in self._q:
+            if p.future.done():
+                continue
+            r = PRIORITY_RANK[p.priority]
+            if r < rank and (
+                victim is None or r < PRIORITY_RANK[victim.priority]
+            ):
+                victim = p
+        if victim is None:
+            return False
+        self._q.remove(victim)
+        self._shed_one(victim, len(self._q))
+        return True
+
     def _enqueue(self, pending: "_Pending") -> None:
         """Admit one request into the (bounded) queue.  Called under
-        ``self._cond``; raises :class:`BackpressureError` when full.
+        ``self._cond``; under overload sheds a lower-priority queued
+        request in its favor, else raises :class:`BackpressureError`.
         Subclasses override to route across several queues."""
-        if len(self._q) >= self.queue_depth:
+        if (
+            len(self._q) >= self.queue_depth
+            and not self._shed_lower_priority(pending)
+        ):
             self.metrics.requests_rejected.inc()
-            raise BackpressureError(self.retry_after_s)
+            raise BackpressureError(
+                self._retry_after_value(
+                    len(self._q), self._jitter_key(pending)
+                )
+            )
         self._q.append(pending)
 
     # -------------------------------------------------------------- submit
-    def submit(
+    def submit_async(
         self,
         payload: Dict[str, Any],
         deadline_ms: Optional[float] = None,
         trace: Optional[Any] = None,
-    ) -> Dict[str, Any]:
-        """Blocking request entry point (one caller thread per in-flight
-        request — the HTTP front end's threading model).  Returns
-        ``{"caption", "tokens", "cached", "timings_ms"}``.  ``trace``
-        is the front end's ``(trace_id, root_span_id)`` — the scheduler
-        parents this request's spans under it and the total-latency
-        histogram stamps the trace_id as its exemplar.
-
-        Raises ``ValueError``/``KeyError`` (bad input),
-        :class:`BackpressureError` (queue full),
-        :class:`DeadlineExceededError` or :class:`ShuttingDownError`
-        (drain in progress).
-        """
-        if not self._running():
-            raise RuntimeError(f"{type(self).__name__} not started")
+        priority: Optional[str] = None,
+    ) -> Union[Dict[str, Any], "_Pending"]:
+        """Non-blocking admission half of :meth:`submit`: parse +
+        prepare + cache lookup + enqueue.  Returns the finished result
+        dict on a tier-1 cache hit, else the enqueued :class:`_Pending`
+        whose future resolves to the result.  The chaos soak harness
+        (serving/chaos.py) drives this directly so its virtual-time
+        replay exercises the REAL admission/shed path."""
         if self._draining:
-            raise ShuttingDownError("server is draining")
+            raise ShuttingDownError(
+                "server is draining", retry_after_s=self.retry_after()
+            )
+        prio = str(
+            priority
+            if priority is not None
+            else payload.get("priority", "interactive")
+        )
+        if prio not in PRIORITY_RANK:
+            raise ValueError(
+                f"unknown priority {prio!r}; have {PRIORITIES}"
+            )
         trace_id = trace[0] if trace else None
         t_submit = time.monotonic()
         prepared = self.engine.prepare(payload)
+        # Chaos site `cache_miss`: a cache-hostile key storm — this
+        # request misses BOTH tiers and pays the full decode (tokens
+        # unaffected; only where the work happens changes).
+        forced_miss = bool(
+            self.chaos is not None and self.chaos.fire("cache_miss")
+        )
+        if forced_miss:
+            self.metrics.chaos_faults.inc()
+            if prepared.enc_row is not None:
+                prepared = prepared._replace(enc_row=None)
         hit = (
             self.engine.lookup_caption(prepared.cache_key)
-            if prepared.cache_key
+            if prepared.cache_key and not forced_miss
             else None
         )
         if hit is not None:
@@ -298,23 +474,75 @@ class _BatcherBase:
             if deadline_ms is not None
             else self.default_deadline_s
         )
-        pending = _Pending(prepared, t_submit + deadline_s, trace=trace)
+        # Chaos site `deadline_skew`: deadline-adjacent arrivals — clamp
+        # this request's budget to the scheduled number of seconds so it
+        # expires in the queue / at admission (the shed path under
+        # test).
+        if self.chaos is not None:
+            skew = self.chaos.fire("deadline_skew")
+            if skew is not False and skew is not None:
+                self.metrics.chaos_faults.inc()
+                deadline_s = min(deadline_s, float(skew))
+        pending = _Pending(
+            prepared, t_submit + deadline_s, trace=trace, priority=prio
+        )
         with self._cond:
             if self._draining:
-                raise ShuttingDownError("server is draining")
+                raise ShuttingDownError(
+                    "server is draining",
+                    retry_after_s=self._retry_after_value(
+                        self._depth_locked(), self._jitter_key(pending)
+                    ),
+                )
             self._enqueue(pending)
             self.metrics.requests_total.inc()
             self._cond.notify_all()
-        # Generous slack: expiry is enforced by the scheduler (which
-        # owns the clock for queued requests) and by the engine-call
-        # bound below; the extra margin only matters if the scheduler
-        # thread died, in which case we surface a timeout.
+        return pending
+
+    def _await(
+        self, pending: "_Pending", deadline_s: float
+    ) -> Dict[str, Any]:
+        """Block the submitter until its future resolves.  Generous
+        slack: expiry is enforced by the scheduler (which owns the clock
+        for queued requests); the extra margin only matters if the
+        scheduler thread died, in which case we surface a timeout.
+        ReplicaSet overrides this with the hedged wait."""
+        return pending.future.result(timeout=deadline_s + 60.0)
+
+    def submit(
+        self,
+        payload: Dict[str, Any],
+        deadline_ms: Optional[float] = None,
+        trace: Optional[Any] = None,
+    ) -> Dict[str, Any]:
+        """Blocking request entry point (one caller thread per in-flight
+        request — the HTTP front end's threading model).  Returns
+        ``{"caption", "tokens", "cached", "timings_ms"}``.  ``trace``
+        is the front end's ``(trace_id, root_span_id)`` — the scheduler
+        parents this request's spans under it and the total-latency
+        histogram stamps the trace_id as its exemplar.  ``payload`` may
+        carry ``priority`` (interactive | batch | best_effort).
+
+        Raises ``ValueError``/``KeyError`` (bad input),
+        :class:`BackpressureError` (queue full, or shed under
+        overload), :class:`DeadlineExceededError` or
+        :class:`ShuttingDownError` (drain in progress).
+        """
+        if not self._running():
+            raise RuntimeError(f"{type(self).__name__} not started")
+        trace_id = trace[0] if trace else None
+        out = self.submit_async(
+            payload, deadline_ms=deadline_ms, trace=trace
+        )
+        if isinstance(out, dict):
+            return out
+        deadline_s = out.deadline - out.t_enqueue
         try:
-            result = pending.future.result(timeout=deadline_s + 60.0)
+            result = self._await(out, deadline_s)
         except DeadlineExceededError:
             raise
         finally:
-            total_ms = (time.monotonic() - t_submit) * 1e3
+            total_ms = (time.monotonic() - out.t_enqueue) * 1e3
             self.metrics.observe_stage("total", total_ms, exemplar=trace_id)
         return result
 
@@ -334,11 +562,10 @@ class _BatcherBase:
                 self._draining = True
                 while self._q:
                     p = self._q.popleft()
-                    if not p.future.done():
+                    if _settle_exception(
+                        p, RuntimeError("scheduler thread died")
+                    ):
                         self.metrics.requests_failed.inc()
-                        p.future.set_exception(
-                            RuntimeError("scheduler thread died")
-                        )
 
     def _loop(self) -> None:  # pragma: no cover — abstract
         raise NotImplementedError
@@ -361,13 +588,31 @@ class _BatcherBase:
                 trace_id=tid, parent_id=root, tags=tags,
             )
 
-    def _expire(self, p: _Pending, now: float) -> None:
+    def _expire(self, p: _Pending, now: float, flight=None) -> None:
+        """Deadline-aware shed: an expired request is failed BEFORE it
+        wastes device work (never served late), counted on both the
+        expired and shed ladders, and leaves a ``shed`` flight event —
+        the post-hoc record the requeue-deadline audit reads."""
         self.metrics.requests_expired.inc()
-        p.future.set_exception(
+        self.metrics.shed(p.priority).inc()
+        recorder = flight if flight is not None else self.flight
+        recorder.event(
+            "shed", priority=p.priority, reason="deadline",
+            requeues=p.requeues,
+        )
+        if p.trace is not None:
+            t = time.monotonic()
+            self.tracer.record(
+                "shed", t, t,
+                trace_id=p.trace[0], parent_id=p.trace[1],
+                tags={"priority": p.priority, "reason": "deadline"},
+            )
+        _settle_exception(
+            p,
             DeadlineExceededError(
                 "deadline exceeded while queued "
                 f"({(now - p.t_enqueue) * 1e3:.0f}ms)"
-            )
+            ),
         )
 
 
@@ -462,10 +707,9 @@ class MicroBatcher(_BatcherBase):
                 [p.prepared for p in live]
             )
         except Exception as e:  # noqa: BLE001 — engine failure maps to 500s
-            self.metrics.requests_failed.inc(len(live))
             for p in live:
-                if not p.future.done():
-                    p.future.set_exception(e)
+                if _settle_exception(p, e):
+                    self.metrics.requests_failed.inc()
             return
         self.tracer.record(
             "batch_decode", t_d0, time.monotonic(),
@@ -481,18 +725,17 @@ class MicroBatcher(_BatcherBase):
             if f"{stage}_ms" in t:
                 self.metrics.observe_stage(stage, t[f"{stage}_ms"])
         for p, res in zip(live, results):
-            self.metrics.requests_served.inc()
-            if not p.future.done():
-                p.future.set_result({
-                    "caption": res.caption,
-                    "tokens": res.tokens,
-                    "cached": False,
-                    "timings_ms": dict(
-                        res.timings_ms,
-                        queue_ms=(now - p.t_enqueue) * 1e3,
-                        batch_size=n,
-                    ),
-                })
+            if _settle_result(p, {
+                "caption": res.caption,
+                "tokens": res.tokens,
+                "cached": False,
+                "timings_ms": dict(
+                    res.timings_ms,
+                    queue_ms=(now - p.t_enqueue) * 1e3,
+                    batch_size=n,
+                ),
+            }):
+                self.metrics.requests_served.inc()
 
 
 class ContinuousBatcher(_BatcherBase):
@@ -535,9 +778,17 @@ class ContinuousBatcher(_BatcherBase):
                         )
                 # Elastic slot banks: let the decoder follow queue
                 # pressure at the tick boundary (pre-jitted transitions,
-                # a no-op with a single fixed bank).
+                # a no-op with a single fixed bank).  Chaos site
+                # `queue_burst` inflates the pressure signal — a
+                # synthetic admission burst hitting a grow boundary.
+                burst = 0
+                if self.chaos is not None:
+                    b = self.chaos.fire("queue_burst")
+                    if b:
+                        burst = int(b)
+                        self.metrics.chaos_faults.inc()
                 before = decoder.resize_count
-                decoder.maybe_resize(len(self._q))
+                decoder.maybe_resize(len(self._q) + burst)
                 if decoder.resize_count != before:
                     self.metrics.slot_bank_resizes.inc(
                         decoder.resize_count - before
@@ -549,7 +800,10 @@ class ContinuousBatcher(_BatcherBase):
                     min(decoder.admit_cap, decoder.S),
                 )
                 while self._q and len(admits) < cap:
-                    admits.append(self._q.popleft())
+                    p = self._q.popleft()
+                    if p.future.done():
+                        continue  # shed/raced copy — nothing to decode
+                    admits.append(p)
             if (
                 drain_deadline is not None
                 and time.monotonic() > drain_deadline
@@ -571,6 +825,17 @@ class ContinuousBatcher(_BatcherBase):
                     self._expire(p, now)
                 else:
                     live.append(p)
+            # Chaos site `tick_stall`: a slow/hung device step — the
+            # scheduler sleeps the scheduled seconds before dispatching.
+            if self.chaos is not None:
+                stall = self.chaos.fire("tick_stall")
+                if stall:
+                    self.metrics.chaos_faults.inc()
+                    self.flight.event(
+                        "chaos_fault", site="tick_stall",
+                        stall_s=float(stall),
+                    )
+                    time.sleep(float(stall))
             # One compiled call per iteration: batched admission scatter
             # (padded-bucket encode) fused with the decode-step block.
             t_tick = time.monotonic()
@@ -580,10 +845,9 @@ class ContinuousBatcher(_BatcherBase):
                 # An admission encode can fail on a bad row — fail those
                 # submitters and keep serving.  A failure with nothing
                 # to admit is the step itself dying: fatal.
-                self.metrics.requests_failed.inc(len(live))
                 for p in live:
-                    if not p.future.done():
-                        p.future.set_exception(e)
+                    if _settle_exception(p, e):
+                        self.metrics.requests_failed.inc()
                 if not live:
                     self._abandon(decoder, [], "scheduler step failed")
                     raise
@@ -621,6 +885,8 @@ class ContinuousBatcher(_BatcherBase):
         """Detokenize + cache + resolve futures for one harvest batch."""
         t0 = time.monotonic()
         for p, tokens, score, steps in harvested:
+            if p.future.done():
+                continue  # already resolved elsewhere (shed/raced copy)
             self.metrics.steps_per_caption.observe(steps)
             self.metrics.observe_stage("device", (t0 - p.t_admit) * 1e3)
             if p.trace is not None:
@@ -640,9 +906,8 @@ class ContinuousBatcher(_BatcherBase):
                     },
                 )
             except Exception as e:  # noqa: BLE001
-                self.metrics.requests_failed.inc()
-                if not p.future.done():
-                    p.future.set_exception(e)
+                if _settle_exception(p, e):
+                    self.metrics.requests_failed.inc()
                 continue
             t1 = time.monotonic()
             if p.trace is not None:
@@ -651,28 +916,25 @@ class ContinuousBatcher(_BatcherBase):
                     trace_id=p.trace[0], parent_id=p.trace[1],
                 )
             self.metrics.observe_stage("detok", (t1 - t0) * 1e3)
-            self.metrics.requests_served.inc()
-            if not p.future.done():
-                p.future.set_result({
-                    "caption": res.caption,
-                    "tokens": res.tokens,
-                    "cached": False,
-                    "score": score,
-                    "timings_ms": dict(
-                        res.timings_ms,
-                        detok_ms=(t1 - t0) * 1e3,
-                        decode_steps=steps,
-                    ),
-                })
+            if _settle_result(p, {
+                "caption": res.caption,
+                "tokens": res.tokens,
+                "cached": False,
+                "score": score,
+                "timings_ms": dict(
+                    res.timings_ms,
+                    detok_ms=(t1 - t0) * 1e3,
+                    decode_steps=steps,
+                ),
+            }):
+                self.metrics.requests_served.inc()
 
     def _abandon(self, decoder, admits: List[_Pending], why: str) -> None:
         for p in admits:
-            if not p.future.done():
+            if _settle_exception(p, RuntimeError(why)):
                 self.metrics.requests_failed.inc()
-                p.future.set_exception(RuntimeError(why))
         for slot in list(decoder.occupied):
             p = decoder.evict(slot)
-            if p is not None and not p.future.done():
+            if p is not None and _settle_exception(p, RuntimeError(why)):
                 self.metrics.requests_failed.inc()
-                p.future.set_exception(RuntimeError(why))
         self.metrics.slots_occupied.set(0)

@@ -31,6 +31,12 @@ DEFAULT_BUCKETS_MS: List[float] = [
 # response.
 STAGES = ("queue", "admission", "pad", "device", "detok", "total")
 
+# Request priority classes, highest-value first.  Under overload the
+# admission path sheds the LOWEST class present before touching anything
+# above it (serving/batcher.py); `caption_shed_total{priority=...}`
+# counts the decisions per class.
+PRIORITIES = ("interactive", "batch", "best_effort")
+
 # Bucket upper bounds for the steps-per-caption histogram (decode steps
 # a caption actually paid before its slot freed — the continuous-mode
 # win is this collapsing toward caption length instead of max_len).
@@ -70,6 +76,12 @@ METRIC_FAMILIES = [
     ("caption_replica_device_steps_total", "counter"),
     ("caption_replica_decode_state_bytes", "gauge"),
     ("caption_replica_slot_bank_size", "gauge"),
+    ("caption_shed_total", "counter"),
+    ("caption_hedges_total", "counter"),
+    ("caption_hedge_cancelled_total", "counter"),
+    ("caption_requeues_total", "counter"),
+    ("caption_requeue_overflow_total", "counter"),
+    ("caption_chaos_faults_total", "counter"),
     ("caption_latency_*_ms", "histogram"),
     ("caption_steps_per_caption", "histogram"),
     ("caption_cache_*", "gauge"),
@@ -116,6 +128,22 @@ METRIC_HELP = {
         "Live decode-state bytes on this replica.",
     "caption_replica_slot_bank_size":
         "This replica's current elastic slot-bank size.",
+    "caption_shed_total":
+        "Requests load-shed per priority class (overload eviction, "
+        "deadline expiry, requeue-budget overflow).",
+    "caption_hedges_total":
+        "Hedged duplicate dispatches onto a second healthy replica.",
+    "caption_hedge_cancelled_total":
+        "Hedged duplicate copies discarded (queued skip or losing "
+        "in-flight copy after first-result-wins).",
+    "caption_requeues_total":
+        "Requests requeued onto survivors after a replica drain.",
+    "caption_requeue_overflow_total":
+        "Requests failed because the server-side requeue budget was "
+        "exhausted (requeue-storm cap).",
+    "caption_chaos_faults_total":
+        "Fault injections fired by the ChaosEngine (zero unless "
+        "serving.chaos is configured).",
     "caption_latency_*_ms":
         "Per-stage request latency in milliseconds.",
     "caption_steps_per_caption":
@@ -292,6 +320,17 @@ class ServingMetrics:
         self.decode_state_bytes = Gauge()
         self.slot_bank_size = Gauge()
         self.slot_bank_resizes = Counter()  # elastic grow/shrink events
+        # Degradation ladder (ISSUE 11): shed decisions per priority
+        # class, hedge dispatch/cancel counts, requeue accounting after
+        # replica drains, and chaos-injection hits.
+        self.shed_total: Dict[str, Counter] = {
+            p: Counter() for p in PRIORITIES
+        }
+        self.hedges_total = Counter()
+        self.hedge_cancelled = Counter()
+        self.requeues_total = Counter()
+        self.requeue_overflow = Counter()
+        self.chaos_faults = Counter()
         # Decode steps each caption actually paid before its slot freed.
         self.steps_per_caption = LatencyHistogram(STEP_BUCKETS)
         # Per-replica label sets, created on first use (replica ids are
@@ -312,6 +351,11 @@ class ServingMetrics:
     def _replica_items(self):
         with self._replicas_lock:
             return sorted(self._replicas.items())
+
+    def shed(self, priority: str) -> Counter:
+        """The shed counter for one priority class (KeyError on an
+        unknown class — priorities are a closed vocabulary)."""
+        return self.shed_total[priority]
 
     def observe_stage(
         self, stage: str, ms: float, exemplar: Optional[str] = None
@@ -345,6 +389,16 @@ class ServingMetrics:
                 "decode_state_bytes": self.decode_state_bytes.value,
                 "bank_size": self.slot_bank_size.value,
                 "bank_resizes": self.slot_bank_resizes.value,
+            },
+            "degradation": {
+                "shed": {
+                    p: c.value for p, c in self.shed_total.items()
+                },
+                "hedges": self.hedges_total.value,
+                "hedge_cancelled": self.hedge_cancelled.value,
+                "requeues": self.requeues_total.value,
+                "requeue_overflow": self.requeue_overflow.value,
+                "chaos_faults": self.chaos_faults.value,
             },
             "latency_ms": {s: h.snapshot() for s, h in self.stages.items()},
         }
@@ -400,10 +454,23 @@ class ServingMetrics:
             "caption_slots_admitted_total": self.slots_admitted_total,
             "caption_slot_device_steps_total": self.slot_steps_total,
             "caption_slot_bank_resizes_total": self.slot_bank_resizes,
+            "caption_hedges_total": self.hedges_total,
+            "caption_hedge_cancelled_total": self.hedge_cancelled,
+            "caption_requeues_total": self.requeues_total,
+            "caption_requeue_overflow_total": self.requeue_overflow,
+            "caption_chaos_faults_total": self.chaos_faults,
         }
         for name, c in counters.items():
             self._header(lines, name, name, "counter")
             lines.append(f"{name} {c.value}")
+        self._header(
+            lines, "caption_shed_total", "caption_shed_total", "counter"
+        )
+        for p in PRIORITIES:
+            lines.append(
+                f'caption_shed_total{{priority="{p}"}} '
+                f"{self.shed_total[p].value}"
+            )
         for name, g in (
             ("caption_slots_total", self.slots_total),
             ("caption_slots_occupied", self.slots_occupied),

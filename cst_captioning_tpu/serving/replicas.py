@@ -33,13 +33,33 @@ front of it:
 * **Replica failure**: a worker that dies (device error, poisoned
   state) marks its replica unhealthy, drains it from routing, and
   requeues its queued AND in-flight requests onto surviving replicas —
-  each bounded by its original deadline (an already-expired request
-  fails with ``DeadlineExceededError``, never silently).  Requeued
-  in-flight work restarts from step 0 on the survivor; per-step math is
+  each bounded by its original deadline (an already-expired request is
+  SHED with ``DeadlineExceededError`` + a flight event, never served
+  late and never silently) and by the server-side retry budget
+  (``serving.requeue_budget``): a request that has already been
+  requeued that many times fails outright instead of amplifying a
+  requeue storm across a flapping fleet.  Requeued in-flight work
+  restarts from step 0 on the survivor; per-step math is
   row-independent, so the survivor's caption is the same caption.
   ``kill_replica`` is the operational handle for the same path.  With
   ZERO healthy replicas, ``submit`` fails with
   :class:`NoHealthyReplicasError` (HTTP 503) and ``/healthz`` degrades.
+* **Request hedging** (``serving.hedge_ms``, ISSUE 11): a submitter
+  whose request has produced no result after the hedge threshold —
+  ``max(hedge_ms, measured p99 of the total-latency histogram)`` —
+  enqueues a duplicate copy onto a second healthy replica.  First
+  result wins (the future settles exactly once via the internal
+  ``_settle_*`` helpers); the losing copy is cancelled at admission if
+  still queued, or its harvest is discarded if it was in flight.
+  Because every replica holds byte-identical weights and the per-step
+  math is row-independent, BOTH copies compute identical rows — hedging
+  can change which replica answers, never the tokens (pinned in
+  tests/test_replicas.py).  0 disables hedging (the default).
+* **Priorities + chaos**: admission shedding (best-effort before
+  interactive under overload) and the ChaosEngine injection sites
+  (``replica_kill`` at the tick boundary, ``tick_stall``,
+  ``queue_burst``) ride the shared batcher machinery — see
+  serving/batcher.py and serving/chaos.py.
 
 Token-exactness: every replica holds byte-identical weights
 (``device_put`` copies, it does not compute), runs the same jitted
@@ -58,13 +78,18 @@ import time
 from collections import deque
 from typing import Any, Deque, List, Optional, Sequence
 
+from concurrent.futures import TimeoutError as FutureTimeoutError
+
 from cst_captioning_tpu.observability.flight import FlightRecorder
 from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
 from cst_captioning_tpu.serving.batcher import (
+    PRIORITY_RANK,
     BackpressureError,
     ShuttingDownError,
     _BatcherBase,
     _Pending,
+    _settle_exception,
+    _settle_result,
 )
 from cst_captioning_tpu.serving.metrics import ServingMetrics
 
@@ -165,6 +190,8 @@ class ReplicaSet(_BatcherBase):
         default_deadline_ms: Optional[float] = None,
         retry_after_s: Optional[float] = None,
         drain_timeout_s: Optional[float] = None,
+        hedge_ms: Optional[float] = None,
+        requeue_budget: Optional[int] = None,
     ):
         if not engines:
             raise ValueError("ReplicaSet needs at least one engine")
@@ -180,6 +207,15 @@ class ReplicaSet(_BatcherBase):
         self.router = Router(router if router is not None else sv.router)
         self.double_buffer = bool(
             sv.double_buffer if double_buffer is None else double_buffer
+        )
+        # Hedge threshold floor in ms (0 = hedging off) and the
+        # server-side requeue budget — see the module doc.
+        self.hedge_ms = float(
+            getattr(sv, "hedge_ms", 0.0) if hedge_ms is None else hedge_ms
+        )
+        self.requeue_budget = int(
+            getattr(sv, "requeue_budget", 3)
+            if requeue_budget is None else requeue_budget
         )
         self.replicas = [Replica(i, e) for i, e in enumerate(engines)]
         self._threads: List[threading.Thread] = []
@@ -280,11 +316,10 @@ class ReplicaSet(_BatcherBase):
             self._threads = []
             for rep in self.replicas:
                 while rep.q:
-                    p = rep.q.popleft()
-                    if not p.future.done():
-                        p.future.set_exception(
-                            RuntimeError("replica set stopped")
-                        )
+                    _settle_exception(
+                        rep.q.popleft(),
+                        RuntimeError("replica set stopped"),
+                    )
                 self.metrics.replica(rep.rid).queue_depth.set(0)
 
     @property
@@ -306,16 +341,114 @@ class ReplicaSet(_BatcherBase):
             self._cond.notify_all()
 
     # ------------------------------------------------------------- routing
+    def _depth_locked(self) -> int:
+        return sum(len(r.q) for r in self.replicas)
+
+    def _shed_lower_priority(self, incoming: _Pending) -> bool:
+        """Overload shed across EVERY replica queue: evict the oldest
+        queued request of the lowest priority class strictly below the
+        incoming one (called under ``self._cond``)."""
+        rank = PRIORITY_RANK[incoming.priority]
+        victim = None
+        victim_rep = None
+        for rep in self.replicas:
+            for p in rep.q:
+                if p.future.done():
+                    continue
+                r = PRIORITY_RANK[p.priority]
+                if r < rank and (
+                    victim is None or r < PRIORITY_RANK[victim.priority]
+                ):
+                    victim, victim_rep = p, rep
+        if victim is None:
+            return False
+        victim_rep.q.remove(victim)
+        self.metrics.replica(victim_rep.rid).queue_depth.set(
+            len(victim_rep.q)
+        )
+        self._shed_one(
+            victim, self._depth_locked(), flight=victim_rep.flight
+        )
+        return True
+
     def _enqueue(self, pending: _Pending) -> None:
         healthy = [r for r in self.replicas if r.healthy]
         if not healthy:
-            raise NoHealthyReplicasError("no healthy replicas")
-        if sum(len(r.q) for r in self.replicas) >= self.queue_depth:
+            raise NoHealthyReplicasError(
+                "no healthy replicas",
+                retry_after_s=self._retry_after_value(
+                    self._depth_locked(), None
+                ),
+            )
+        if (
+            self._depth_locked() >= self.queue_depth
+            and not self._shed_lower_priority(pending)
+        ):
             self.metrics.requests_rejected.inc()
-            raise BackpressureError(self.retry_after_s)
+            raise BackpressureError(
+                self._retry_after_value(
+                    self._depth_locked(), self._jitter_key(pending)
+                )
+            )
         rep = self.router.pick(healthy)
+        pending.rid = rep.rid
         rep.q.append(pending)
         self.metrics.replica(rep.rid).queue_depth.set(len(rep.q))
+
+    # ------------------------------------------------------------- hedging
+    def _hedge_threshold_s(self) -> Optional[float]:
+        """Latency hedge threshold in seconds, or None when hedging is
+        off.  p99-derived: once the total-latency histogram has enough
+        mass, the threshold floats at max(hedge_ms, measured p99) so
+        only genuinely slow requests hedge; ``hedge_ms`` is the floor
+        and the cold-start value."""
+        if self.hedge_ms <= 0:
+            return None
+        h = self.metrics.stages["total"]
+        ms = self.hedge_ms
+        if h.count >= 32:
+            ms = max(ms, h.percentile(99))
+        return ms / 1e3
+
+    def _hedge(self, pending: _Pending) -> None:
+        """Dispatch a duplicate copy of a slow request onto a second
+        healthy replica (first result wins — both copies share one
+        future, settled exactly once)."""
+        with self._cond:
+            if pending.future.done() or pending.hedged:
+                return
+            survivors = [
+                r for r in self.replicas
+                if r.healthy and r.rid != pending.rid
+            ]
+            if not survivors:
+                return
+            rep = self.router.pick(survivors)
+            pending.hedged = True
+            rep.q.append(pending)
+            self.metrics.hedges_total.inc()
+            self.metrics.replica(rep.rid).queue_depth.set(len(rep.q))
+            self._cond.notify_all()
+        rep.flight.event("hedge", frm=pending.rid, to=rep.rid)
+        if pending.trace is not None:
+            t = time.monotonic()
+            self.tracer.record(
+                "hedge", t, t,
+                trace_id=pending.trace[0], parent_id=pending.trace[1],
+                tags={"from": pending.rid, "to": rep.rid},
+            )
+
+    def _await(self, pending: _Pending, deadline_s: float):
+        hedge_s = self._hedge_threshold_s()
+        if hedge_s is None or hedge_s >= deadline_s:
+            return super()._await(pending, deadline_s)
+        try:
+            return pending.future.result(timeout=hedge_s)
+        except FutureTimeoutError:
+            pass
+        self._hedge(pending)
+        remaining = pending.deadline - time.monotonic()
+        return pending.future.result(timeout=max(remaining, 0.0) + 60.0)
 
     # ------------------------------------------------------------- workers
     def _worker(self, rep: Replica) -> None:
@@ -337,6 +470,16 @@ class ReplicaSet(_BatcherBase):
         outstanding = None          # un-waited TickHandle (double buffer)
         drain_deadline: Optional[float] = None
         while True:
+            # Chaos site `replica_kill`: die through the REAL death
+            # path (unhealthy -> drain from routing -> deadline-bounded
+            # requeue onto survivors).  Counted per ACTIVE scheduler
+            # iteration of this replica.
+            if self.chaos is not None and self.chaos.fire(
+                "replica_kill", replica=rep.rid
+            ):
+                self.metrics.chaos_faults.inc()
+                rep.flight.event("chaos_fault", site="replica_kill")
+                raise _ReplicaDied()
             admits: List[_Pending] = []
             with self._cond:
                 while (
@@ -372,8 +515,14 @@ class ReplicaSet(_BatcherBase):
                 # outstanding double-buffered handles stay harvestable
                 # (they carry their own output arrays, and the
                 # admit-tick guard bounds their slot indices).
+                burst = 0
+                if self.chaos is not None:
+                    b = self.chaos.fire("queue_burst", replica=rep.rid)
+                    if b:
+                        burst = int(b)
+                        self.metrics.chaos_faults.inc()
                 before = decoder.resize_count
-                decoder.maybe_resize(len(rep.q))
+                decoder.maybe_resize(len(rep.q) + burst)
                 if decoder.resize_count != before:
                     self.metrics.slot_bank_resizes.inc(
                         decoder.resize_count - before
@@ -387,7 +536,14 @@ class ReplicaSet(_BatcherBase):
                     min(decoder.admit_cap, decoder.S),
                 )
                 while rep.q and len(admits) < cap:
-                    admits.append(rep.q.popleft())
+                    p = rep.q.popleft()
+                    if p.future.done():
+                        # Hedge loser cancellation: the other copy won
+                        # (or the request was shed) before this copy
+                        # reached a slot — drop it for free.
+                        self.metrics.hedge_cancelled.inc()
+                        continue
+                    admits.append(p)
                 rm.queue_depth.set(len(rep.q))
             if (
                 drain_deadline is not None
@@ -407,9 +563,21 @@ class ReplicaSet(_BatcherBase):
             live: List[_Pending] = []
             for p in admits:
                 if now > p.deadline:
-                    self._expire(p, now)
+                    self._expire(p, now, flight=rep.flight)
                 else:
                     live.append(p)
+            # Chaos site `tick_stall`: a slow/hung device step on THIS
+            # replica — the worker sleeps the scheduled seconds before
+            # dispatching (hedging and the router route around it).
+            if self.chaos is not None:
+                stall = self.chaos.fire("tick_stall", replica=rep.rid)
+                if stall:
+                    self.metrics.chaos_faults.inc()
+                    rep.flight.event(
+                        "chaos_fault", site="tick_stall",
+                        stall_s=float(stall),
+                    )
+                    time.sleep(float(stall))
             # Dispatch tick t+1 FIRST (double buffer) so the harvest of
             # tick t below overlaps its device compute.
             t_tick = time.monotonic()
@@ -421,10 +589,9 @@ class ReplicaSet(_BatcherBase):
                 # A failed admission encode fails those submitters and
                 # the replica keeps serving; a failure with nothing to
                 # admit is the step itself dying: replica death.
-                self.metrics.requests_failed.inc(len(live))
                 for p in live:
-                    if not p.future.done():
-                        p.future.set_exception(e)
+                    if _settle_exception(p, e):
+                        self.metrics.requests_failed.inc()
                 if not live:
                     raise
                 continue
@@ -477,6 +644,11 @@ class ReplicaSet(_BatcherBase):
         per-replica caption counter)."""
         t0 = time.monotonic()
         for p, tokens, score, steps in harvested:
+            if p.future.done():
+                # Hedge loser: the other replica's copy won the race
+                # (identical tokens by construction) — discard.
+                self.metrics.hedge_cancelled.inc()
+                continue
             self.metrics.steps_per_caption.observe(steps)
             self.metrics.observe_stage("device", (t0 - p.t_admit) * 1e3)
             if p.trace is not None:
@@ -496,9 +668,8 @@ class ReplicaSet(_BatcherBase):
                     },
                 )
             except Exception as e:  # noqa: BLE001
-                self.metrics.requests_failed.inc()
-                if not p.future.done():
-                    p.future.set_exception(e)
+                if _settle_exception(p, e):
+                    self.metrics.requests_failed.inc()
                 continue
             t1 = time.monotonic()
             if p.trace is not None:
@@ -508,34 +679,33 @@ class ReplicaSet(_BatcherBase):
                     tags={"replica": rep.rid},
                 )
             self.metrics.observe_stage("detok", (t1 - t0) * 1e3)
-            self.metrics.requests_served.inc()
-            rm.captions_total.inc()
-            if not p.future.done():
-                p.future.set_result({
-                    "caption": res.caption,
-                    "tokens": res.tokens,
-                    "cached": False,
-                    "score": score,
-                    "replica": rep.rid,
-                    "timings_ms": dict(
-                        res.timings_ms,
-                        detok_ms=(t1 - t0) * 1e3,
-                        decode_steps=steps,
-                    ),
-                })
+            if _settle_result(p, {
+                "caption": res.caption,
+                "tokens": res.tokens,
+                "cached": False,
+                "score": score,
+                "replica": rep.rid,
+                "timings_ms": dict(
+                    res.timings_ms,
+                    detok_ms=(t1 - t0) * 1e3,
+                    decode_steps=steps,
+                ),
+            }):
+                self.metrics.requests_served.inc()
+                rm.captions_total.inc()
+            else:
+                self.metrics.hedge_cancelled.inc()
 
     def _abandon(
         self, rep: Replica, admits: List[_Pending], why: str
     ) -> None:
         for p in admits:
-            if not p.future.done():
+            if _settle_exception(p, RuntimeError(why)):
                 self.metrics.requests_failed.inc()
-                p.future.set_exception(RuntimeError(why))
         for slot in list(rep.decoder.occupied):
             p = rep.decoder.evict(slot)
-            if p is not None and not p.future.done():
+            if p is not None and _settle_exception(p, RuntimeError(why)):
                 self.metrics.requests_failed.inc()
-                p.future.set_exception(RuntimeError(why))
         self.metrics.replica(rep.rid).slots_occupied.set(0)
 
     # -------------------------------------------------------- failure path
@@ -544,7 +714,7 @@ class ReplicaSet(_BatcherBase):
         its queued + in-flight requests onto surviving replicas —
         bounded by each request's original deadline.  Runs on the dying
         worker's own thread (the decoder's single owner)."""
-        requeued = expired = failed = 0
+        requeued = expired = failed = overflowed = 0
         with self._cond:
             rep.healthy = False
             rm = self.metrics.replica(rep.rid)
@@ -561,23 +731,45 @@ class ReplicaSet(_BatcherBase):
                 if p is None or p.future.done():
                     continue
                 if now > p.deadline:
-                    self._expire(p, now)
+                    # Shed, never served late: the ORIGINAL deadline
+                    # rides through every requeue (the fuzzed
+                    # requeue-deadline audit pins this).
+                    self._expire(p, now, flight=rep.flight)
                     expired += 1
-                elif survivors:
+                elif not survivors:
+                    if _settle_exception(p, RuntimeError(
+                        f"{why}; no healthy replicas left"
+                    )):
+                        self.metrics.requests_failed.inc()
+                    failed += 1
+                elif p.requeues >= self.requeue_budget:
+                    # Server-side retry budget: a request bounced across
+                    # this many replica deaths fails outright instead of
+                    # feeding a requeue storm.
+                    self.metrics.requeue_overflow.inc()
+                    self.metrics.shed(p.priority).inc()
+                    rep.flight.event(
+                        "shed", priority=p.priority,
+                        reason="requeue_budget", requeues=p.requeues,
+                    )
+                    if _settle_exception(p, RuntimeError(
+                        f"{why}; requeue budget "
+                        f"({self.requeue_budget}) exhausted"
+                    )):
+                        self.metrics.requests_failed.inc()
+                    overflowed += 1
+                else:
                     # Accepted work is never dropped: requeue even past
                     # queue_depth (the bound gates NEW admissions only).
+                    p.requeues += 1
+                    self.metrics.requeues_total.inc()
                     r2 = self.router.pick(survivors)
+                    p.rid = r2.rid
                     r2.q.append(p)
                     self.metrics.replica(r2.rid).queue_depth.set(
                         len(r2.q)
                     )
                     requeued += 1
-                else:
-                    self.metrics.requests_failed.inc()
-                    p.future.set_exception(
-                        RuntimeError(f"{why}; no healthy replicas left")
-                    )
-                    failed += 1
             self.metrics.slots_total.set(
                 sum(r.decoder.S for r in self.replicas if r.healthy)
             )
@@ -588,13 +780,14 @@ class ReplicaSet(_BatcherBase):
         rep.flight.event(
             "drain_requeue",
             requeued=requeued, expired=expired, failed=failed,
-            survivors=self.healthy_replicas,
+            overflowed=overflowed, survivors=self.healthy_replicas,
         )
         rep.flight.dump(why)
         _log.warning(
             "%s: drained from routing (%d requeued, %d expired, "
-            "%d failed; %d healthy replicas remain)",
-            why, requeued, expired, failed, self.healthy_replicas,
+            "%d failed, %d over budget; %d healthy replicas remain)",
+            why, requeued, expired, failed, overflowed,
+            self.healthy_replicas,
         )
 
     # ----------------------------------------------------------------- info

@@ -3,11 +3,15 @@
 Endpoints:
 
 * ``POST /v1/caption`` — body ``{"features": {modality: [[...], ...]},
-  "feature_id": str?, "category": int?, "deadline_ms": float?}`` ->
+  "feature_id": str?, "category": int?, "deadline_ms": float?,
+  "priority": "interactive"|"batch"|"best_effort"?}`` ->
   ``{"caption", "tokens", "cached", "timings_ms"}``.  Errors: 400 (bad
   input), 404 (unknown ``feature_id`` with no features), 429 (queue
-  full; ``Retry-After`` header set), 503 (draining/shutdown), 504
-  (deadline exceeded), 500 (engine failure).
+  full or shed under overload), 503 (draining/shutdown), 504 (deadline
+  exceeded), 500 (engine failure).  429 AND 503 responses carry a
+  ``Retry-After`` header computed from the live queue depth plus a
+  deterministic per-request jitter (never a constant — a synchronized
+  client retry storm can't re-overload a recovering fleet; ISSUE 11).
 * ``GET /healthz`` — liveness + engine description + the deploy
   fingerprint (``build``: params_tag / mesh_shape / preset / version —
   the correlation key between flight dumps, bench records, and a
@@ -188,8 +192,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(404, {"error": f"no route {self.path}"})
             return
         if self.server.draining:
+            hdrs = {}
+            hint = getattr(self.server.batcher, "retry_after", None)
+            if callable(hint):
+                hdrs["Retry-After"] = f"{hint():.3f}"
             self._send_json(
-                503, {"error": "server is draining; not accepting requests"}
+                503,
+                {"error": "server is draining; not accepting requests"},
+                headers=hdrs,
             )
             return
         try:
@@ -235,7 +245,10 @@ class _Handler(BaseHTTPRequestHandler):
             )
         except ShuttingDownError as e:
             status = 503
-            self._send_json(503, {"error": str(e)}, headers=hdrs)
+            h503 = dict(hdrs)
+            if getattr(e, "retry_after_s", None):
+                h503["Retry-After"] = f"{e.retry_after_s:.3f}"
+            self._send_json(503, {"error": str(e)}, headers=h503)
         except DeadlineExceededError as e:
             status = 504
             self._send_json(504, {"error": str(e)}, headers=hdrs)

@@ -45,6 +45,7 @@ _FAMILY_OF_PREFIX = {
     "CST-MET": "metrics_registry",
     "CST-SHD": "partitioning",
     "CST-OBS": "observability",
+    "CST-RES": "resilience",
 }
 
 
@@ -118,6 +119,29 @@ class TestPackageClean:
         assert ("training/steps.py", "make_xe_train_step.train_step") in traced.roots
         assert ("decoding/core.py", "decode_step") in traced.static
         assert ("decoding/core.py", "decode_step") not in traced.roots
+
+    def test_resilience_pass_sees_the_real_injection_sites(self):
+        """Vacuous-green guard for CST-RES: the checker must discover
+        the REAL chaos.fire sites in serving/ — every registered
+        FAULT_SITES name with at least one live call site, all of them
+        guarded (the package scan stays at zero findings)."""
+        from cst_captioning_tpu.analysis import resilience as rz
+        from cst_captioning_tpu.serving.chaos import FAULT_SITES
+
+        mods = [
+            m for m in scan_package(PACKAGE_ROOT)
+            if not m.rel.startswith("analysis/")
+        ]
+        sites = rz.fire_sites(mods)
+        assert len(sites) >= 6
+        names = {name for _, _, name in sites if name}
+        assert names == {s for s, _, _ in FAULT_SITES}
+        files = {mi.rel for mi, _, _ in sites}
+        assert {"serving/batcher.py", "serving/replicas.py"} <= files
+        for mi, node, name in sites:
+            assert rz._is_guarded(mi, node), (
+                f"{mi.rel}:{node.lineno} chaos site {name} unguarded"
+            )
 
     def test_partition_pass_sees_rules_and_constraint_sites(self):
         """Vacuous-green guard for CST-SHD: the checker must actually

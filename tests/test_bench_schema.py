@@ -166,6 +166,42 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="trace_overhead_ratio"):
             validate_record(rec)
 
+    def test_slo_soak_fields_pass(self):
+        """ISSUE 11: chaos-soak SLO rows are numeric by contract, with
+        attainment fields constrained to the unit interval."""
+        rec = good_bench()
+        rec["extra"].update({
+            "slo_reference_attainment": 1.0,
+            "slo_chaos_attainment_interactive": 0.67,
+            "slo_chaos_attainment_best_effort": 0.5,
+            "slo_host_cores": 1.0,
+            "slo_chaos_seed": 1123.0,
+            "slo_chaos_lost": 0.0,
+            "slo_replay_mismatches": 0.0,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "1.0", [1.0]])
+    def test_non_numeric_slo_field_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["slo_chaos_seed"] = bad
+        with pytest.raises(ValueError, match="slo_chaos_seed"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [-0.1, 1.5, 2])
+    def test_attainment_outside_unit_interval_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["slo_reference_attainment"] = bad
+        with pytest.raises(ValueError, match="attainment fraction"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None])
+    def test_bool_attainment_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["slo_chaos_attainment_overall"] = bad
+        with pytest.raises(ValueError, match="slo_chaos_attainment"):
+            validate_record(rec)
+
     def test_mesh_shape_string_passes(self):
         """*_mesh_shape fields carry the topology a row ran on (ISSUE
         9): a "2x4"-style string in declared axis order."""
@@ -197,6 +233,56 @@ class TestBenchKind:
         rec["value"] = "1.23"
         with pytest.raises(ValueError, match="value"):
             validate_record(rec)
+
+
+class TestSLOGate:
+    """The SLO regression gate (ISSUE 11): bench exits non-zero with a
+    NAMED reason when reference-load attainment drops below the pinned
+    threshold — the check that turns the bench suite from a speedometer
+    into a survival certificate."""
+
+    def test_gate_passes_at_and_above_threshold(self):
+        from bench import SLO_GATE_MIN, slo_gate
+
+        assert slo_gate({"slo_reference_attainment": 1.0}) is None
+        assert slo_gate(
+            {"slo_reference_attainment": SLO_GATE_MIN}
+        ) is None
+
+    def test_gate_fails_below_threshold_with_named_reason(self):
+        from bench import SLO_GATE_MIN, slo_gate
+
+        reason = slo_gate(
+            {"slo_reference_attainment": SLO_GATE_MIN - 0.05}
+        )
+        assert reason is not None
+        assert "slo_regression" in reason
+        assert str(SLO_GATE_MIN) in reason
+
+    def test_gate_skips_when_soak_did_not_run(self):
+        from bench import slo_gate
+
+        assert slo_gate({}) is None
+
+    def test_gate_rejects_non_numeric_attainment(self):
+        from bench import slo_gate
+
+        reason = slo_gate({"slo_reference_attainment": True})
+        assert reason is not None and "non-numeric" in reason
+
+    def test_gate_trip_exits_three_even_when_measured(self):
+        """The exit-code contract: a tripped gate outranks 'something
+        was measured' — the run fails loudly with the dedicated code."""
+        from bench import bench_exit_code
+
+        assert bench_exit_code(True, {}) == 0
+        assert bench_exit_code(False, {}) == 1
+        assert bench_exit_code(
+            True, {"slo_gate": "slo_regression: ..."}
+        ) == 3
+        assert bench_exit_code(
+            False, {"slo_gate": "slo_regression: ..."}
+        ) == 3
 
 
 class TestMultichipKinds:

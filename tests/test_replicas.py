@@ -498,6 +498,33 @@ class TestCrossReplicaParity:
             range(rs.replicas[0].decoder.S)
         )
 
+    def test_hedged_requests_stay_token_exact(self, replica_world):
+        """ISSUE 11 tentpole bar: with an aggressive hedge threshold
+        (~every request hedges onto the second replica), first result
+        wins — and every caption is STILL exactly the offline beam
+        decode, served exactly once.  Both replicas hold byte-identical
+        weights and the per-step math is row-independent, so the two
+        copies compute identical rows; hedging can change which replica
+        answers, never the tokens."""
+        engine, clones, ds, offline, payloads = replica_world
+        engine.cache.captions.clear()
+        rng = np.random.RandomState(17)
+        idx = list(rng.permutation(10))
+        rs = ReplicaSet(clones, double_buffer=True, hedge_ms=1.0)
+        results, errors = _fuzz_submit(rs, payloads, idx, rng)
+        assert not errors, errors
+        assert len(results) == 10
+        for i in range(10):
+            assert results[i]["caption"] == offline[ds.video_id(i)], (
+                f"video {i}: hedged decode diverged from offline beam"
+            )
+        assert rs.metrics.hedges_total.value >= 1
+        # Exactly one result per request despite the duplicate copies.
+        assert rs.metrics.requests_served.value == 10
+        assert rs.metrics.requests_failed.value == 0
+        for rep in rs.replicas:
+            assert not rep.decoder.occupied
+
     def test_cross_replica_cache_hit_admits_with_zero_encode(
         self, replica_world
     ):

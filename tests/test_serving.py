@@ -1033,6 +1033,53 @@ class TestElasticSlotBanks:
         assert not dec.occupied
         assert sorted(dec.free) == list(range(dec.S))
 
+    def test_chaos_queue_burst_during_regrow_never_drops(
+        self, elastic_world
+    ):
+        """ISSUE 11 satellite (extends the fuzz above): chaos-injected
+        admission bursts hammer the pressure signal while real traffic
+        decodes across bank transitions — grows fire mid-traffic, the
+        free/occupied partition stays exact, nothing drops or
+        double-assigns (the decoder hard-raises), and every caption is
+        still token-exact vs the offline beam decode."""
+        from cst_captioning_tpu.data.vocab import decode_sequence
+        from cst_captioning_tpu.serving.chaos import ChaosEngine
+
+        eng, dec, ds, offline, payloads = elastic_world
+        ce = ChaosEngine(seed=7, schedule=[
+            {"site": "queue_burst", "every": 2, "value": 6},
+        ])
+        reqs = [eng.prepare(dict(p)) for p in payloads]
+        pending = list(zip(reqs, range(len(reqs))))
+        got = {}
+        grew = 0
+        while pending or dec.occupied:
+            b = ce.fire("queue_burst")
+            burst = int(b) if b else 0
+            s0 = dec.S
+            dec.maybe_resize(len(pending) + burst)
+            grew += dec.S > s0
+            occ, free = set(dec.occupied), set(dec.free)
+            assert not (occ & free)
+            assert occ | free == set(range(dec.S))
+            n = min(1, len(pending), len(dec.free), dec.admit_cap)
+            batch = [pending.pop(0) for _ in range(n)]
+            done = dec.tick(
+                [r for r, _ in batch], [d for _, d in batch]
+            )
+            for d, tokens, _score, _steps in dec.harvest_many(done):
+                got[d] = tokens
+        assert grew >= 1 and ce.fired >= 1
+        assert sorted(got) == list(range(len(payloads)))
+        for i, tokens in got.items():
+            assert (
+                decode_sequence(eng.vocab, tokens[None])[0]
+                == offline[ds.video_id(i)]
+            ), f"video {i} diverged under chaos-burst regrow"
+        # Walk the bank back down so later tests see the idle state.
+        for _ in range(dec.shrink_after * 4):
+            dec.maybe_resize(0)
+
 
 class TestBeamEarlyExit:
     """The offline scan beam's all-rows-finished early exit
