@@ -171,6 +171,14 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
                     f"{k!r} must be an attainment fraction in [0, 1], "
                     f"got {v!r}"
                 )
+        # Analysis-preflight provenance (ISSUE 12): every analysis_*
+        # extra is a measurement by contract — finding/rule/file
+        # counts and durations are numbers, never bool/None/prose
+        # (validate_record is how the driver trusts the row ran a
+        # real, cache-accounted invariant pass).
+        for k, v in rec["extra"].items():
+            if k.startswith("analysis_") and not _is_number(v):
+                fail(f"{k!r} must be a real number, got {v!r}")
         # Mesh topology is a machine-readable string by contract
         # (ISSUE 9): any *_mesh_shape field must look like "2x4" —
         # axis sizes joined by "x" in declared axis order.  A bool,
@@ -2661,15 +2669,27 @@ def main() -> int:
     # but ``errors.analysis`` marks them.  BENCH_SKIP_ANALYSIS=1 bypasses.
     if not int(os.environ.get("BENCH_SKIP_ANALYSIS", "0") or 0):
         try:
+            from pathlib import Path as _Path
+
             from cst_captioning_tpu.analysis import (
                 run_analysis,
                 validate_report,
             )
 
-            _rep = run_analysis()
+            # ISSUE 12: the preflight rides the incremental cache —
+            # an unchanged tree re-validates in milliseconds, and the
+            # record says how much was reused (cache_hit_files) and
+            # how many rule families gated the run (rules_active).
+            _cache_dir = _Path(
+                os.environ.get("BENCH_ANALYSIS_CACHE", "")
+                or _Path(__file__).resolve().parent / ".analysis_cache"
+            )
+            _rep = run_analysis(cache_dir=_cache_dir)
             validate_report(_rep.to_dict())
             extra["analysis_findings"] = len(_rep.findings)
             extra["analysis_duration_s"] = round(_rep.duration_s, 3)
+            extra["analysis_rules_active"] = len(_rep.rules_run)
+            extra["analysis_cache_hit_files"] = _rep.cache_hit_files
             if not _rep.clean:
                 errors["analysis"] = "; ".join(
                     f.render() for f in _rep.findings[:5]

@@ -21,3 +21,7 @@ from cst_captioning_tpu.analysis.engine import (  # noqa: F401
     run_analysis,
     validate_report,
 )
+from cst_captioning_tpu.analysis.sarif import (  # noqa: F401
+    to_sarif,
+    validate_sarif,
+)

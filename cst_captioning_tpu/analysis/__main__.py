@@ -2,11 +2,22 @@
 
     python -m cst_captioning_tpu.analysis            # human output
     python -m cst_captioning_tpu.analysis --json     # machine-readable
+    python -m cst_captioning_tpu.analysis --sarif    # SARIF 2.1.0
     python -m cst_captioning_tpu.analysis --rules single_site,donation
+    python -m cst_captioning_tpu.analysis --cache          # warm reuse
+    python -m cst_captioning_tpu.analysis --changed-only   # diff focus
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 over the wall-clock
 budget (``ANALYSIS_BUDGET_S``, default 30 — the same discipline as
 ``TIER1_BUDGET_S``: a slow pass silently eats the suite's headroom).
+
+The incremental cache (``--cache`` / ``--cache-dir PATH``, default
+store ``.analysis_cache/``) reuses the full report when nothing that
+can change it changed; ``--changed-only`` additionally restricts the
+REPORTED findings (and the exit code) to files whose content hash
+moved since the last cached run — the "what did my diff introduce"
+view.  Both are plain content-hash machinery (analysis/cache.py), no
+daemon, no state beyond one JSON file.
 """
 
 from __future__ import annotations
@@ -20,6 +31,7 @@ from pathlib import Path
 from cst_captioning_tpu.analysis.engine import run_analysis, validate_report
 
 DEFAULT_BUDGET_S = 30.0
+DEFAULT_CACHE_DIR = ".analysis_cache"
 
 
 def main(argv=None) -> int:
@@ -27,9 +39,14 @@ def main(argv=None) -> int:
         prog="python -m cst_captioning_tpu.analysis",
         description="Run the invariant engine over the package.",
     )
-    ap.add_argument(
+    out_fmt = ap.add_mutually_exclusive_group()
+    out_fmt.add_argument(
         "--json", action="store_true",
         help="emit the machine-readable report on stdout",
+    )
+    out_fmt.add_argument(
+        "--sarif", action="store_true",
+        help="emit a SARIF 2.1.0 document on stdout",
     )
     ap.add_argument(
         "--rules", default="",
@@ -39,18 +56,72 @@ def main(argv=None) -> int:
         "--root", default="",
         help="package root to scan (default: the installed package)",
     )
+    ap.add_argument(
+        "--cache", action="store_true",
+        help=f"enable the incremental cache ({DEFAULT_CACHE_DIR}/)",
+    )
+    ap.add_argument(
+        "--cache-dir", default="",
+        help="cache store directory (implies --cache)",
+    )
+    ap.add_argument(
+        "--changed-only", action="store_true",
+        help="report only findings in files changed since the last "
+             "cached run (implies --cache)",
+    )
     args = ap.parse_args(argv)
 
+    cache_dir = None
+    if args.cache or args.cache_dir or args.changed_only:
+        cache_dir = Path(args.cache_dir or DEFAULT_CACHE_DIR)
+
     budget = float(os.environ.get("ANALYSIS_BUDGET_S", DEFAULT_BUDGET_S))
+    root = Path(args.root) if args.root else None
+
+    changed = None
+    if args.changed_only and cache_dir is not None:
+        # Baseline BEFORE the run (the run overwrites the store).
+        from cst_captioning_tpu.analysis import cache as _cache
+        from cst_captioning_tpu.analysis.engine import (
+            default_package_root,
+        )
+
+        files = _cache.file_digests(root or default_package_root())
+        changed = _cache.changed_files(cache_dir, files)
+
     report = run_analysis(
-        Path(args.root) if args.root else None,
+        root,
         rules=[r for r in args.rules.split(",") if r] or None,
+        cache_dir=cache_dir,
     )
+    findings = report.findings
+    if changed is not None:
+        changed_set = set(changed)
+        findings = [f for f in findings if f.file in changed_set]
+
     if args.json:
         rec = validate_report(report.to_dict())
         print(json.dumps(rec, indent=2))
+    elif args.sarif:
+        from cst_captioning_tpu.analysis.sarif import (
+            to_sarif,
+            validate_sarif,
+        )
+
+        doc = validate_sarif(to_sarif(report.to_dict()))
+        print(json.dumps(doc, indent=2))
     else:
-        print(report.render())
+        if changed is not None:
+            lines = [f.render() for f in findings]
+            lines.append(
+                f"analysis (changed-only, {len(changed)} changed "
+                f"file(s)): {len(findings)} finding(s), "
+                f"{report.files_scanned} files, "
+                f"{report.duration_s:.2f}s"
+            )
+            print("\n".join(lines))
+        else:
+            print(report.render())
     if budget and report.duration_s > budget:
         print(
             f"ANALYSIS BUDGET EXCEEDED: {report.duration_s:.1f}s > "
@@ -58,7 +129,7 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
-    return 0 if report.clean else 1
+    return 0 if not findings else 1
 
 
 if __name__ == "__main__":

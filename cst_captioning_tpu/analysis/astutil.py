@@ -235,12 +235,21 @@ class PackageIndex:
                 if m2 and sym in m2.functions:
                     return [m2.functions[sym]]
             return out
-        # dotted: module alias (core.decode_step) or class attr
+        # dotted: module alias (core.decode_step), imported class
+        # (ChaosEngine.from_config), or local class attr
         imp = mi.imports.get(head)
         if imp and imp.startswith(PACKAGE):
             m2 = self.by_modname.get(imp)
             if m2 and rest in m2.functions:
                 return [m2.functions[rest]]
+            modname, _, clsname = imp.rpartition(".")
+            m2 = self.by_modname.get(modname)
+            if m2 and f"{clsname}.{rest}" in m2.functions:
+                return [m2.functions[f"{clsname}.{rest}"]]
+        if head in mi.classes and "." not in rest:
+            fi = mi.functions.get(f"{head}.{rest}")
+            if fi:
+                return [fi]
         return out
 
 

@@ -25,6 +25,7 @@ enough for a preflight.
 
 from __future__ import annotations
 
+import datetime as _dt
 import json
 import time
 from dataclasses import dataclass, field
@@ -93,12 +94,15 @@ def register_checker(name: str) -> Callable[[Checker], Checker]:
 def _load_checkers() -> None:
     # Import-for-side-effect: each module registers its rule family.
     from cst_captioning_tpu.analysis import (  # noqa: F401
+        configflow,
         donation,
+        exceptions,
         jit_boundary,
         metrics_registry,
         observability,
         partitioning,
         resilience,
+        rng,
         single_site,
         thread_safety,
     )
@@ -110,12 +114,22 @@ def _load_checkers() -> None:
 class Suppression:
     """One annotated suppression: silences findings whose (rule, file,
     symbol) all match.  ``justification`` is REQUIRED non-empty prose —
-    an unexplained suppression is itself a finding."""
+    an unexplained suppression is itself a finding.  ``expires``
+    (optional, ``"YYYY-MM-DD"``) dates the debt: past the date the
+    entry fires CST-SUP-002, so a "temporary" suppression surfaces
+    instead of rotting."""
 
     rule: str
     file: str
     symbol: str
     justification: str
+    expires: Optional[str] = None
+
+    def expired(self, today: Optional["_dt.date"] = None) -> bool:
+        if not self.expires:
+            return False
+        today = today or _dt.date.today()
+        return _dt.date.fromisoformat(self.expires) < today
 
 
 def load_suppressions(
@@ -168,9 +182,26 @@ def load_suppressions(
                 "justification — every suppression must say WHY",
             ))
             continue
+        expires = e.get("expires")
+        if expires is not None:
+            if not isinstance(expires, str):
+                problems.append(Finding(
+                    "CST-SUP-001", path.name, 1, where,
+                    "'expires' must be a \"YYYY-MM-DD\" string",
+                ))
+                continue
+            try:
+                _dt.date.fromisoformat(expires)
+            except ValueError:
+                problems.append(Finding(
+                    "CST-SUP-001", path.name, 1, where,
+                    f"'expires' {expires!r} is not a valid "
+                    "YYYY-MM-DD date",
+                ))
+                continue
         entries.append(Suppression(
             rule=e["rule"], file=e["file"], symbol=e["symbol"],
-            justification=e["justification"],
+            justification=e["justification"], expires=expires,
         ))
     return entries, problems
 
@@ -189,6 +220,7 @@ class Report:
     rules_run: List[str]
     files_scanned: int
     duration_s: float
+    cache_hit_files: int = 0    # files served from the incremental cache
 
     @property
     def clean(self) -> bool:
@@ -200,6 +232,7 @@ class Report:
             "clean": self.clean,
             "duration_s": round(self.duration_s, 3),
             "files_scanned": self.files_scanned,
+            "cache_hit_files": self.cache_hit_files,
             "rules_run": list(self.rules_run),
             "findings": [f.to_dict() for f in self.findings],
             "suppressed": [
@@ -211,6 +244,48 @@ class Report:
                 for s in self.unused_suppressions
             ],
         }
+
+    def to_stable_dict(self) -> Dict[str, Any]:
+        """The run-invariant payload: everything except the measured
+        ``duration_s`` and the cache provenance — the byte-identical
+        contract cold and warm cached runs are pinned against."""
+        d = self.to_dict()
+        d.pop("duration_s")
+        d.pop("cache_hit_files")
+        return d
+
+    @classmethod
+    def from_stable_dict(
+        cls, d: Dict[str, Any], *, duration_s: float,
+        cache_hit_files: int,
+    ) -> "Report":
+        """Rebuild a Report from a stored stable payload (the cache
+        warm path)."""
+        findings = [Finding(**f) for f in d["findings"]]
+        suppressed = []
+        for f in d["suppressed"]:
+            just = f["justification"]
+            core = {k: v for k, v in f.items() if k != "justification"}
+            suppressed.append((
+                Finding(**core),
+                Suppression(
+                    rule=core["rule"], file=core["file"],
+                    symbol=core["symbol"], justification=just,
+                ),
+            ))
+        unused = [
+            Suppression(justification="", **u)
+            for u in d["unused_suppressions"]
+        ]
+        return cls(
+            findings=findings,
+            suppressed=suppressed,
+            unused_suppressions=unused,
+            rules_run=list(d["rules_run"]),
+            files_scanned=d["files_scanned"],
+            duration_s=duration_s,
+            cache_hit_files=cache_hit_files,
+        )
 
     def render(self) -> str:
         lines = [f.render() for f in self.findings]
@@ -258,6 +333,12 @@ def validate_report(rec: Any) -> Dict[str, Any]:
         rec["files_scanned"], int
     ) or rec["files_scanned"] < 0:
         fail("'files_scanned' must be a non-negative int")
+    if "cache_hit_files" in rec:
+        v = rec["cache_hit_files"]
+        if isinstance(v, bool) or not isinstance(v, int) or v < 0:
+            fail("'cache_hit_files' must be a non-negative int")
+        if v > rec["files_scanned"]:
+            fail("'cache_hit_files' exceeds 'files_scanned'")
     if not (
         isinstance(rec["rules_run"], list)
         and all(isinstance(r, str) and r for r in rec["rules_run"])
@@ -304,15 +385,44 @@ def run_analysis(
     rules: Optional[Sequence[str]] = None,
     suppressions_path: Optional[Path] = None,
     docs_root: Optional[Path] = None,
+    cache_dir: Optional[Path] = None,
 ) -> Report:
     """Parse ``package_root`` once, run the requested rule families
-    (default: all), apply suppressions, return the :class:`Report`."""
+    (default: all), apply suppressions, return the :class:`Report`.
+
+    ``cache_dir`` enables the incremental cache (analysis/cache.py):
+    when nothing that can change the report changed — sources,
+    suppressions, docs, rule selection — the stored report is
+    reconstructed without parsing or checking anything
+    (``cache_hit_files`` reports the reuse; the stable payload is
+    byte-identical to a cold run by construction)."""
     t0 = time.perf_counter()
     _load_checkers()
     root = Path(package_root) if package_root else default_package_root()
     if docs_root is None:
         cand = root.parent / "docs"
         docs_root = cand if cand.is_dir() else None
+    names = list(rules) if rules else sorted(CHECKERS)
+    spath_early = Path(suppressions_path or default_suppressions_path())
+    cache_key = None
+    cache_files = None
+    if cache_dir is not None:
+        from cst_captioning_tpu.analysis import cache as _cache
+
+        cache_key, cache_files = _cache.compute_key(
+            root,
+            rules=names,
+            suppressions_path=spath_early,
+            docs_root=docs_root,
+            report_version=REPORT_VERSION,
+        )
+        hit = _cache.load(Path(cache_dir), cache_key)
+        if hit is not None:
+            return Report.from_stable_dict(
+                hit,
+                duration_s=time.perf_counter() - t0,
+                cache_hit_files=hit["files_scanned"],
+            )
     modules = scan_package(root)
     # The analysis package audits the rest of the package; its own
     # sources (pattern tables, rule text) would trip the single-site
@@ -321,7 +431,6 @@ def run_analysis(
     ctx = CheckContext(
         index=PackageIndex(modules), package_root=root, docs_root=docs_root
     )
-    names = list(rules) if rules else sorted(CHECKERS)
     unknown = [n for n in names if n not in CHECKERS]
     if unknown:
         raise ValueError(
@@ -330,16 +439,28 @@ def run_analysis(
     all_findings: List[Finding] = []
     for name in names:
         all_findings.extend(CHECKERS[name](modules, ctx))
-    spath = suppressions_path or default_suppressions_path()
-    sups, sup_problems = load_suppressions(Path(spath))
+    sups, sup_problems = load_suppressions(spath_early)
     all_findings.extend(sup_problems)
+    # Dated debt surfaces (CST-SUP-002): an entry past its ``expires``
+    # date keeps matching (so its target shows up exactly once, here)
+    # but the expiry itself is an unsuppressable finding.
+    for s in sups:
+        if s.expired():
+            all_findings.append(Finding(
+                "CST-SUP-002", spath_early.name, 1,
+                f"{s.rule}@{s.file}[{s.symbol}]",
+                f"suppression of {s.rule} at {s.file} expired on "
+                f"{s.expires} — the recorded debt "
+                f"({s.justification!r:.120}) is due: fix the finding "
+                "or re-justify with a new date",
+            ))
 
     kept: List[Finding] = []
     suppressed: List[Tuple[Finding, Suppression]] = []
     used = set()
     for f in sorted(all_findings, key=lambda f: (f.file, f.line, f.rule)):
         hit = next((s for s in sups if _matches(s, f)), None)
-        if hit is not None and f.rule != "CST-SUP-001":
+        if hit is not None and not f.rule.startswith("CST-SUP-"):
             suppressed.append((f, hit))
             used.add((hit.rule, hit.file, hit.symbol))
         else:
@@ -347,7 +468,7 @@ def run_analysis(
     unused = [
         s for s in sups if (s.rule, s.file, s.symbol) not in used
     ]
-    return Report(
+    report = Report(
         findings=kept,
         suppressed=suppressed,
         unused_suppressions=unused,
@@ -355,3 +476,11 @@ def run_analysis(
         files_scanned=len(modules),
         duration_s=time.perf_counter() - t0,
     )
+    if cache_dir is not None and cache_key is not None:
+        from cst_captioning_tpu.analysis import cache as _cache
+
+        _cache.store(
+            Path(cache_dir), cache_key, report.to_stable_dict(),
+            cache_files or {},
+        )
+    return report

@@ -195,32 +195,25 @@ def _collect_roots(modules: List[ModuleInfo], traced: _TracedSet) -> None:
 def _expand(
     modules: List[ModuleInfo], ctx: CheckContext, traced: _TracedSet
 ) -> None:
-    """Close the traced set over nested defs + the package call graph."""
+    """Close the traced set over nested defs + the package call graph
+    (the shared :func:`dataflow.expand_call_closure` worklist — the
+    resilience/observability passes ride the same machinery)."""
+    from cst_captioning_tpu.analysis.dataflow import expand_call_closure
+
     by_mod = {m.rel: m for m in modules}
-    work = [
+    seeds = [
         by_mod[rel].functions[qn]
         for (rel, qn) in list(traced.static)
         if rel in by_mod
     ]
-    while work:
-        fn = work.pop()
-        mi = fn.module
-        # nested defs are traced with their parent
-        prefix = fn.qualname + "."
-        for qn, sub in mi.functions.items():
-            if qn.startswith(prefix) and sub not in traced:
-                traced.add(sub, f"nested in traced {fn.qualname}")
-                work.append(sub)
-        for call in (
-            n for n in walk_body(fn) if isinstance(n, ast.Call)
-        ):
-            for callee in ctx.index.resolve_call(mi, fn, call):
-                if callee not in traced:
-                    traced.add(
-                        callee,
-                        f"called from traced {mi.rel}::{fn.qualname}",
-                    )
-                    work.append(callee)
+
+    def admit(fn: FuncInfo, reason: str) -> bool:
+        if fn in traced:
+            return False
+        traced.add(fn, reason)
+        return True
+
+    expand_call_closure(modules, ctx, seeds, admit)
 
 
 def _test_is_static(test: ast.AST) -> bool:

@@ -1,0 +1,428 @@
+"""CST-RNG: PRNG key discipline over the def-use dataflow layer.
+
+Every parity pin in docs/PARITY.md — slot-geometry invariance,
+padded-vs-slot bit-identical params, fixed-seed reproducibility —
+ultimately rests on disciplined JAX key handling: keys are split or
+folded, never reused; every draw's key traces back to the seeded root;
+rollout-path token draws are keyed by ROW IDENTITY
+(``fold_in(fold_in(rng, row_id), t)``, PARITY r10) so slot position and
+admission order cannot change a sampled token.  These rules
+machine-check that contract with :mod:`analysis.dataflow`'s per-function
+def-use chains:
+
+* CST-RNG-001 — a key binding consumed by TWO draws without an
+  intervening ``split``/``fold_in`` redefinition (the classic JAX
+  key-reuse bug: silently correlated randomness), including the loop
+  flavor — a draw inside a ``for``/``while`` whose key is bound
+  outside the loop reuses the key every iteration.  Draws on the two
+  arms of one ``if``/``else`` are mutually exclusive and do NOT fire.
+* CST-RNG-002 — untracked entropy: a ``jax.random.PRNGKey``/``key``
+  root seeded from a nondeterministic source (``time.*``,
+  ``np.random.*``, ``os.urandom``, stdlib ``random.*``, ``secrets``,
+  ``uuid``), or a draw whose key is a free name bound nowhere
+  (parameter, enclosing scope, module level, import, or attribute all
+  count as tracked).  Untracked entropy breaks every fixed-seed
+  bit-identical pin at once.
+* CST-RNG-003 — a rollout-flavored token draw
+  (``jax.random.categorical``, vmapped or direct) outside
+  :data:`ROW_KEYED_ALLOWED` — the CST-DEC single-site discipline
+  applied to the sampling recurrence: the row-keyed contract lives in
+  ``decoding/core.py`` (``row_sample_fn``), and the legacy batch
+  stream in ``models/captioner.py``; a new call site would bypass the
+  PARITY r10 row-keying argument entirely.
+
+Derivation calls (``split``/``fold_in``) are transparent to the
+provenance walk; ``PRNGKey``/``key`` with a deterministic seed
+expression IS the registered root (the seed is config state, pinned at
+``train.seed``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from cst_captioning_tpu.analysis.astutil import (
+    FuncInfo,
+    ModuleInfo,
+    call_name,
+    dotted,
+)
+from cst_captioning_tpu.analysis.dataflow import (
+    Binding,
+    DefUse,
+    provenance_chain,
+)
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+
+# Files allowed to call the token-sampling draw (CST-RNG-003) — the
+# row-keyed noise source (decoding/core.py::row_sample_fn + the legacy
+# batch stream of decode_step) and the scan-path scheduled-sampling /
+# rollout draw inside the model.  Extending this list is a conscious
+# decision, exactly like the CST-DEC allowlists.
+ROW_KEYED_ALLOWED = frozenset({
+    "decoding/core.py",
+    "models/captioner.py",
+})
+
+# jax.random functions that CONSUME a key (first arg / key=).
+DRAW_FNS = frozenset({
+    "categorical", "uniform", "normal", "bernoulli", "bits", "gumbel",
+    "truncated_normal", "choice", "randint", "permutation", "shuffle",
+    "exponential", "laplace", "poisson", "gamma", "beta", "dirichlet",
+    "multivariate_normal", "rademacher", "cauchy", "logistic",
+    "loggamma", "orthogonal", "binomial", "ball",
+})
+# Functions that DERIVE fresh keys from a parent (transparent to the
+# provenance walk; reuse of the parent across derivations is the
+# intended fold_in idiom, not a bug).
+DERIVE_FNS = frozenset({"split", "fold_in", "clone"})
+# Root-key constructors: a deterministic seed here IS the registry.
+ROOT_FNS = frozenset({"PRNGKey", "key"})
+
+_NONDET_PREFIXES = (
+    "time.", "np.random.", "numpy.random.", "random.", "secrets.",
+    "uuid.", "os.urandom", "os.getrandom",
+)
+
+
+def _resolved(mi: ModuleInfo, node: ast.Call) -> str:
+    """Dotted callee resolved through the import map (so ``from
+    jax.random import categorical as cat`` still reads
+    ``jax.random.categorical``)."""
+    callee = dotted(node.func)
+    if not callee:
+        return ""
+    head, _, rest = callee.partition(".")
+    target = mi.imports.get(head)
+    if target:
+        return target + (("." + rest) if rest else "")
+    return callee
+
+
+def _random_fn(mi: ModuleInfo, node: ast.Call) -> str:
+    """``"categorical"`` for any spelling of a ``jax.random.*`` call,
+    ``""`` otherwise.  numpy's host RNG is CST-JIT-001's domain and is
+    explicitly excluded."""
+    name = _resolved(mi, node)
+    if not name.startswith("jax.random."):
+        # stdlib random / np.random are host RNG (CST-JIT-001's
+        # domain), not key consumers.
+        return ""
+    fn = name.split(".")[-1]
+    return fn if fn in DRAW_FNS | DERIVE_FNS | ROOT_FNS else ""
+
+
+def _vmapped_draw(mi: ModuleInfo, node: ast.Call) -> str:
+    """``jax.vmap(jax.random.categorical)(keys, x)`` — the row-keyed
+    idiom: the OUTER call is the draw, its first arg the key batch."""
+    if not isinstance(node.func, ast.Call):
+        return ""
+    inner = node.func
+    if call_name(inner).split(".")[-1] != "vmap" or not inner.args:
+        return ""
+    target = inner.args[0]
+    if isinstance(target, ast.Call):
+        return ""
+    name = dotted(target)
+    if not name:
+        return ""
+    head, _, rest = name.partition(".")
+    resolved = mi.imports.get(head)
+    full = (resolved + ("." + rest if rest else "")) if resolved else name
+    if full.startswith("jax.random.") and full.split(".")[-1] in DRAW_FNS:
+        return full.split(".")[-1]
+    return ""
+
+
+def _key_arg(node: ast.Call) -> Optional[ast.AST]:
+    for kw in node.keywords:
+        if kw.arg == "key":
+            return kw.value
+    return node.args[0] if node.args else None
+
+
+def draw_sites(
+    modules: List[ModuleInfo],
+) -> List[Tuple[ModuleInfo, FuncInfo, ast.Call, str, Optional[ast.AST]]]:
+    """Every key-consuming draw site in the package:
+    ``(module, function, call, fn_name, key_expr)``.  The vacuous-green
+    guard in tests pins that this discovers the REAL sampling sites
+    (decode_step's categorical, the captioner's scheduled-sampling
+    draws, the dropout bernoulli …)."""
+    out = []
+    for mi in modules:
+        for qn, fn in mi.functions.items():
+            for node in _body_calls(fn):
+                name = _random_fn(mi, node)
+                if name in DRAW_FNS:
+                    out.append((mi, fn, node, name, _key_arg(node)))
+                    continue
+                vname = _vmapped_draw(mi, node)
+                if vname:
+                    out.append((
+                        mi, fn, node, vname,
+                        node.args[0] if node.args else None,
+                    ))
+    return out
+
+
+def _body_calls(fn: FuncInfo):
+    from cst_captioning_tpu.analysis.astutil import walk_body
+
+    for node in walk_body(fn):
+        if isinstance(node, ast.Call):
+            yield node
+
+
+def _ancestors(mi: ModuleInfo, node: ast.AST) -> List[ast.AST]:
+    out = []
+    cur = mi.parent.get(node)
+    while cur is not None:
+        out.append(cur)
+        cur = mi.parent.get(cur)
+    return out
+
+
+def _in_subtree(root: ast.AST, node: ast.AST, mi: ModuleInfo) -> bool:
+    cur = node
+    while cur is not None:
+        if cur is root:
+            return True
+        cur = mi.parent.get(cur)
+    return False
+
+
+def _disjoint_branches(
+    mi: ModuleInfo, a: ast.AST, b: ast.AST
+) -> bool:
+    """Whether two nodes sit on mutually exclusive arms of one
+    ``if``/``else`` (or ``try``/``except``) — both can never execute
+    in the same pass, so a key consumed once per arm is a single
+    consumption."""
+    for anc in _ancestors(mi, a):
+        if isinstance(anc, ast.If):
+            a_in_body = any(_in_subtree(s, a, mi) for s in anc.body)
+            a_in_else = any(_in_subtree(s, a, mi) for s in anc.orelse)
+            b_in_body = any(_in_subtree(s, b, mi) for s in anc.body)
+            b_in_else = any(_in_subtree(s, b, mi) for s in anc.orelse)
+            if (a_in_body and b_in_else) or (a_in_else and b_in_body):
+                return True
+        if isinstance(anc, ast.Try):
+            a_in_try = any(_in_subtree(s, a, mi) for s in anc.body)
+            b_in_h = any(
+                _in_subtree(h, b, mi) for h in anc.handlers
+            )
+            a_in_h = any(
+                _in_subtree(h, a, mi) for h in anc.handlers
+            )
+            b_in_try = any(_in_subtree(s, b, mi) for s in anc.body)
+            if (a_in_try and b_in_h) or (a_in_h and b_in_try):
+                return True
+    return False
+
+
+def _enclosing_loops(
+    mi: ModuleInfo, node: ast.AST, fn: FuncInfo
+) -> List[ast.AST]:
+    """``for``/``while`` ancestors of ``node`` within ``fn``'s body."""
+    out = []
+    cur = mi.parent.get(node)
+    while cur is not None and cur is not fn.node:
+        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+            out.append(cur)
+        if isinstance(
+            cur, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            break
+        cur = mi.parent.get(cur)
+    return out
+
+
+def _nondet_entropy(mi: ModuleInfo, expr: ast.AST) -> Optional[str]:
+    """Dotted name of a nondeterministic-source call inside ``expr``."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = _resolved(mi, node)
+            if name.startswith(_NONDET_PREFIXES) or name in (
+                "os.urandom", "os.getrandom",
+            ):
+                return name
+    return None
+
+
+def _module_level_names(mi: ModuleInfo) -> set:
+    names = set(mi.imports)
+    for node in mi.tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.add(n.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(
+            node.target, ast.Name
+        ):
+            names.add(node.target.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            names.add(node.name)
+    return names
+
+
+def _key_through(mi: ModuleInfo):
+    """Provenance ``through`` hook: derivation calls are transparent —
+    keep chasing their parent-key operand."""
+    def through(call: ast.Call) -> Optional[ast.AST]:
+        name = _random_fn(mi, call)
+        if name in DERIVE_FNS:
+            return _key_arg(call)
+        return None
+
+    return through
+
+
+def row_key_fold_depth(
+    mi: ModuleInfo, fn: FuncInfo
+) -> Optional[int]:
+    """For a vmapped row-keyed draw inside ``fn``: the ``fold_in``
+    nesting depth of the per-row key expression (2 for the PARITY r10
+    ``fold_in(fold_in(rng, row_id), t)`` contract), or None when no
+    such site exists.  The tests' vacuous-green guard pins this
+    proves the REAL contract at ``decoding/core.py::row_sample_fn``."""
+    du = DefUse(fn)
+    for node in _body_calls(fn):
+        if not _vmapped_draw(mi, node) or not node.args:
+            continue
+        key_expr = node.args[0]
+        if not isinstance(key_expr, ast.Name):
+            continue
+        b = du.reaching_def(key_expr)
+        if b is None or b.value is None:
+            continue
+        # keys = jax.vmap(lambda r, t: fold_in(fold_in(base, r), t))(…)
+        for n in ast.walk(b.value):
+            if isinstance(n, ast.Lambda):
+                depth, cur = 0, n.body
+                while isinstance(cur, ast.Call) and _random_fn(
+                    mi, cur
+                ) == "fold_in":
+                    depth += 1
+                    cur = _key_arg(cur)
+                if depth:
+                    return depth
+    return None
+
+
+@register_checker("rng")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    out: List[Finding] = []
+
+    for mi in modules:
+        mod_names = None  # lazy
+        for qn, fn in mi.functions.items():
+            sites = []
+            for node in _body_calls(fn):
+                name = _random_fn(mi, node)
+                if name in ROOT_FNS:
+                    src = _nondet_entropy(
+                        mi, node.args[0] if node.args else node
+                    )
+                    if src is not None:
+                        out.append(Finding(
+                            "CST-RNG-002", mi.rel, node.lineno, qn,
+                            f"PRNG root seeded from `{src}` — "
+                            "nondeterministic entropy breaks every "
+                            "fixed-seed bit-identical pin; seed from "
+                            "config (train.seed) and derive with "
+                            "fold_in/split",
+                        ))
+                    continue
+                if name in DRAW_FNS:
+                    sites.append((node, name, _key_arg(node)))
+                    continue
+                vname = _vmapped_draw(mi, node)
+                if vname:
+                    sites.append((
+                        node, vname,
+                        node.args[0] if node.args else None,
+                    ))
+            if not sites:
+                continue
+            # walk_body is stack-ordered; consumption counting needs
+            # SOURCE order so the second draw is the one that fires
+            sites.sort(key=lambda s: (s[0].lineno, s[0].col_offset))
+            du = DefUse(fn)
+            through = _key_through(mi)
+            consumed: Dict[int, Tuple[ast.AST, Binding]] = {}
+            for node, name, key in sites:
+                # ---- RNG-003: token draws stay at the allowlisted
+                # row-keyed definition sites -----------------------
+                if name == "categorical" and mi.rel not in (
+                    ROW_KEYED_ALLOWED
+                ):
+                    out.append(Finding(
+                        "CST-RNG-003", mi.rel, node.lineno, qn,
+                        "rollout-flavored token draw (categorical) "
+                        "outside the row-keyed allowlist — sampled "
+                        "tokens must come from decoding/core.py's "
+                        "row-keyed machinery (fold_in(fold_in(rng, "
+                        "row_id), t), PARITY r10) so slot geometry "
+                        "and admission order cannot change any token",
+                    ))
+                if key is None or not isinstance(key, ast.Name):
+                    continue
+                # ---- RNG-002: key provenance through the def-use
+                # chains (split/fold_in transparent) -----------------
+                orig = provenance_chain(fn, du, key, through=through)
+                if orig.kind == "free":
+                    if mod_names is None:
+                        mod_names = _module_level_names(mi)
+                    if orig.name not in mod_names:
+                        out.append(Finding(
+                            "CST-RNG-002", mi.rel, node.lineno, qn,
+                            f"draw `{name}` keyed by `{orig.name}`, "
+                            "which is bound nowhere (not a parameter, "
+                            "enclosing scope, module global or "
+                            "import) — untracked entropy; thread the "
+                            "key in from the seeded root",
+                        ))
+                b = du.reaching_def(key)
+                if b is None:
+                    continue
+                # ---- RNG-001: one binding, one consumption ----------
+                prev = consumed.get(id(b))
+                if prev is not None and not _disjoint_branches(
+                    mi, prev[0], node
+                ):
+                    out.append(Finding(
+                        "CST-RNG-001", mi.rel, node.lineno, qn,
+                        f"key `{key.id}` consumed again by `{name}` "
+                        f"(first drawn at line {prev[0].lineno}) "
+                        "without an intervening split/fold_in — "
+                        "reused keys draw CORRELATED randomness "
+                        "silently; split the key per draw",
+                    ))
+                else:
+                    consumed[id(b)] = (node, b)
+                # loop flavor: key bound outside the enclosing loop
+                for loop in _enclosing_loops(mi, node, fn):
+                    def_inside = (
+                        b.stmt is not None
+                        and b.kind != "param"
+                        and _in_subtree(loop, b.stmt, mi)
+                    )
+                    if not def_inside:
+                        out.append(Finding(
+                            "CST-RNG-001", mi.rel, node.lineno, qn,
+                            f"key `{key.id}` drawn inside a loop but "
+                            "bound outside it — every iteration "
+                            "reuses the same key (correlated draws); "
+                            "derive a per-iteration key with "
+                            "fold_in(key, i)",
+                        ))
+                        break
+    return out

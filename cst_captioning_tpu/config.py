@@ -4,6 +4,15 @@ Replaces the reference's ``opts.py`` (argparse, ~200-400 LoC of flags) and the
 ``Makefile`` variable layering (dataset / feature set / training stage).  Every
 reference flag has a field here; ``docs/PARITY.md`` holds the flag-for-flag
 table.  Presets 1-5 mirror the driver acceptance configs (BASELINE.json:6-12).
+
+Knob lifecycle is machine-checked (ISSUE 12, ``analysis/configflow.py``):
+every dotted read anywhere in the package must name a field declared
+here (CST-CFG-001 — ``Config.from_dict`` validates writes from JSON,
+the analysis pass validates reads), every declared field must be read
+somewhere (CST-CFG-002) and listed in the docs/ANALYSIS.md knob
+catalogue (CST-CFG-003), and presets may only assign declared fields
+(CST-CFG-004).  Adding a field means wiring it AND adding its
+catalogue row, or the pass goes red.
 """
 
 from __future__ import annotations

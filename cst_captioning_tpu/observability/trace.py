@@ -402,6 +402,19 @@ class Tracer:
     def export_json(self) -> str:
         return json.dumps(self.export_chrome_trace())
 
+    def set_buffer_spans(self, n: int) -> None:
+        """Re-bound the rings to ``n`` spans (the
+        ``serving.trace_buffer_spans`` knob): the shared retired ring
+        immediately (newest spans kept), per-thread rings for threads
+        that register after the call — live threads own their deques,
+        so resizing them in place would race their appends."""
+        n = int(n)
+        if n <= 0 or n == self.buffer_spans:
+            return
+        with self._lock:
+            self.buffer_spans = n
+            self._retired = deque(self._retired, maxlen=n)
+
     def clear(self) -> None:
         with self._lock:
             bufs = list(self._bufs)
@@ -419,7 +432,13 @@ _GLOBAL = Tracer()
 _NULL = Tracer(enabled=False)
 
 
-def get_tracer() -> Tracer:
+def get_tracer(buffer_spans: Optional[int] = None) -> Tracer:
+    """The process-global tracer.  ``buffer_spans`` (the
+    ``serving.trace_buffer_spans`` knob) re-bounds the rings: the
+    retired ring immediately (newest spans kept), per-thread rings for
+    threads registered after the call."""
+    if buffer_spans:
+        _GLOBAL.set_buffer_spans(buffer_spans)
     return _GLOBAL
 
 

@@ -207,7 +207,7 @@ class _BatcherBase:
         # for the scheduler thread — recent ticks/lifecycle events,
         # dumped on scheduler death / watchdog / drain.
         self.tracer = (
-            get_tracer()
+            get_tracer(int(getattr(sv, "trace_buffer_spans", 0) or 0))
             if getattr(sv, "tracing", True) else null_tracer()
         )
         self.flight = FlightRecorder(

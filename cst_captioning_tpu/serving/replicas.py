@@ -150,7 +150,7 @@ class Replica:
         # so the dump also carries this replica's recent spans.
         sv = engine.cfg.serving
         tracer = (
-            get_tracer()
+            get_tracer(int(getattr(sv, "trace_buffer_spans", 0) or 0))
             if getattr(sv, "tracing", True) else null_tracer()
         )
         self.flight = FlightRecorder(

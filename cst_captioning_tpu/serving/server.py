@@ -228,48 +228,42 @@ class _Handler(BaseHTTPRequestHandler):
             hdrs["X-Trace-Id"] = trace[0]
         t_root = time.monotonic()
         status = 500
+        body: Dict[str, Any] = {"error": "internal error"}
         try:
             result = srv.batcher.submit(
                 payload, deadline_ms=deadline_ms, trace=trace
             )
-            status = 200
-            self._send_json(200, result, headers=hdrs)
+            status, body = 200, result
         except BackpressureError as e:
             status = 429
-            self._send_json(
-                429,
-                {"error": str(e), "retry_after_s": e.retry_after_s},
-                headers=dict(
-                    hdrs, **{"Retry-After": f"{e.retry_after_s:.3f}"}
-                ),
-            )
+            body = {"error": str(e), "retry_after_s": e.retry_after_s}
+            hdrs["Retry-After"] = f"{e.retry_after_s:.3f}"
         except ShuttingDownError as e:
-            status = 503
-            h503 = dict(hdrs)
+            status, body = 503, {"error": str(e)}
             if getattr(e, "retry_after_s", None):
-                h503["Retry-After"] = f"{e.retry_after_s:.3f}"
-            self._send_json(503, {"error": str(e)}, headers=h503)
+                hdrs["Retry-After"] = f"{e.retry_after_s:.3f}"
         except DeadlineExceededError as e:
-            status = 504
-            self._send_json(504, {"error": str(e)}, headers=hdrs)
+            status, body = 504, {"error": str(e)}
         except KeyError as e:
-            status = 404
-            self._send_json(404, {"error": str(e)}, headers=hdrs)
+            status, body = 404, {"error": str(e)}
         except (ValueError, TypeError) as e:
-            status = 400
-            self._send_json(400, {"error": str(e)}, headers=hdrs)
+            status, body = 400, {"error": str(e)}
         except Exception as e:  # noqa: BLE001 — last-resort 500
             _log.exception("caption request failed")
-            self._send_json(
-                500, {"error": f"{type(e).__name__}: {e}"}, headers=hdrs
+            status, body = 500, {"error": f"{type(e).__name__}: {e}"}
+        # Root span recorded BEFORE the response leaves: a client that
+        # holds the response (and its X-Trace-Id) must find the root
+        # span already present at /debug/trace — recording after
+        # _send_json raced exactly that read.  The span no longer
+        # covers the response's socket write; queue/decode/detok are
+        # measured scheduler-side regardless.
+        if trace is not None:
+            srv.tracer.record(
+                "request", t_root, time.monotonic(),
+                trace_id=trace[0], span_id=trace[1],
+                tags={"status": status},
             )
-        finally:
-            if trace is not None:
-                srv.tracer.record(
-                    "request", t_root, time.monotonic(),
-                    trace_id=trace[0], span_id=trace[1],
-                    tags={"status": status},
-                )
+        self._send_json(status, body, headers=hdrs)
 
 
 class _Server(ThreadingHTTPServer):
@@ -306,27 +300,35 @@ class _Server(ThreadingHTTPServer):
             self._profiling = True
 
         def _window() -> None:
-            import jax
-
-            t0 = time.monotonic()
+            # The whole body is exception-contained (CST-EXC-002): an
+            # exception escaping a profiler thread would vanish into
+            # threading's stderr hook with the window flag stuck True
+            # (every later /debug/profile 409s forever).
             try:
-                jax.profiler.start_trace(self.profile_dir)
-                time.sleep(ms / 1e3)
-            finally:
+                import jax
+
+                t0 = time.monotonic()
                 try:
-                    jax.profiler.stop_trace()
-                except Exception:  # noqa: BLE001 — stop is best-effort
-                    _log.exception("profiler stop_trace failed")
-                self.tracer.record(
-                    "profile", t0, time.monotonic(),
-                    tags={"ms": ms, "out_dir": self.profile_dir},
+                    jax.profiler.start_trace(self.profile_dir)
+                    time.sleep(ms / 1e3)
+                finally:
+                    try:
+                        jax.profiler.stop_trace()
+                    except Exception:  # noqa: BLE001 — stop is best-effort
+                        _log.exception("profiler stop_trace failed")
+                    self.tracer.record(
+                        "profile", t0, time.monotonic(),
+                        tags={"ms": ms, "out_dir": self.profile_dir},
+                    )
+                _log.info(
+                    "profiler window (%.0fms) written to %s",
+                    ms, self.profile_dir,
                 )
+            except Exception:  # noqa: BLE001 — window dies loudly
+                _log.exception("profiler window failed")
+            finally:
                 with self._profile_lock:
                     self._profiling = False
-            _log.info(
-                "profiler window (%.0fms) written to %s",
-                ms, self.profile_dir,
-            )
 
         threading.Thread(
             target=_window, name="caption-profile", daemon=True
@@ -370,7 +372,8 @@ class CaptionServer:
         self._http.batcher = self.batcher
         self._http.metrics = self.metrics
         self._http.tracer = (
-            get_tracer() if sv.tracing else null_tracer()
+            get_tracer(int(getattr(sv, "trace_buffer_spans", 0) or 0))
+            if sv.tracing else null_tracer()
         )
         self._http.profile_dir = str(sv.profile_dir or "")
         self._thread: Optional[threading.Thread] = None
@@ -413,7 +416,7 @@ class CaptionServer:
             signal.signal(
                 signal.SIGTERM,
                 lambda *_: threading.Thread(
-                    target=self.shutdown, name="caption-sigterm",
+                    target=self._signal_shutdown, name="caption-sigterm",
                     daemon=True,
                 ).start(),
             )
@@ -429,6 +432,16 @@ class CaptionServer:
         rejects new submits; in-flight work keeps running."""
         self._http._draining_evt.set()
         self.batcher.begin_drain()
+
+    def _signal_shutdown(self) -> None:
+        """SIGTERM thread body (CST-EXC-002): ``shutdown()`` with a
+        last-resort log — an exception escaping a signal-spawned
+        thread would otherwise vanish mid-drain with the listener
+        half-down and nothing recorded."""
+        try:
+            self.shutdown()
+        except Exception:  # noqa: BLE001 — drain failure must be loud
+            _log.exception("SIGTERM shutdown failed")
 
     def shutdown(self, drain: bool = True) -> None:
         """Graceful stop: 503 new requests, drain queued + in-flight

@@ -1,0 +1,38 @@
+# corpus-rules: configflow
+"""Corpus twin of the real config module: a miniature dataclass tree
+the configflow checker resolves sections/fields from, seeded with a
+dead knob (read nowhere in the corpus), an undocumented knob (absent
+from the sibling docs/ANALYSIS.md catalogue), and a preset typo."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TrainConfig:
+    learning_rate: float = 1e-4
+    seed: int = 0
+    dead_knob: int = 7  # expect: CST-CFG-002
+
+
+@dataclass
+class ServingConfig:
+    port: int = 8000
+    undocumented_knob: int = 1  # expect: CST-CFG-003
+
+
+@dataclass
+class Config:
+    train: TrainConfig = field(default_factory=TrainConfig)
+    serving: ServingConfig = field(default_factory=ServingConfig)
+
+
+def preset_ok():
+    c = Config()
+    c.train.seed = 5              # declared: fine
+    return c
+
+
+def preset_typo():
+    c = Config()
+    c.train.learning_rte = 1.0  # expect: CST-CFG-004
+    return c

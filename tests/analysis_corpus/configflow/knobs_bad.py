@@ -1,0 +1,32 @@
+# corpus-rules: configflow
+"""Seeded config-read hazards against the sibling corpus config.py:
+typo'd dotted reads, typo'd getattr string reads, typo'd alias reads —
+plus the negative read shapes (direct, getattr, alias) that keep the
+declared knobs alive."""
+
+
+def read_knobs(cfg):
+    lr = cfg.train.learning_rate          # declared: fine
+    s = cfg.train.seed                    # declared: fine
+    p = cfg.serving.port                  # declared: fine
+    u = cfg.serving.undocumented_knob     # read, just not documented
+    typo = cfg.train.learning_rte  # expect: CST-CFG-001
+    g = getattr(cfg.serving, "prot", 0)  # expect: CST-CFG-001
+    return lr, s, p, u, typo, g
+
+
+def read_through_alias(cfg):
+    sv = cfg.serving
+    ok = sv.port                          # alias read: fine
+    bad = sv.reqeue_budget  # expect: CST-CFG-001
+    also_ok = getattr(sv, "port", 0)
+    return ok, bad, also_ok
+
+
+def read_through_param(serving_cfg):
+    # section-typed parameter: the caller below passes cfg.serving
+    return serving_cfg.port
+
+
+def call_with_section(cfg):
+    return read_through_param(cfg.serving)

@@ -1,0 +1,79 @@
+# corpus-rules: exceptions
+"""Seeded silent-exception hazards on the threaded surface: a swallow
+inside a worker loop, a swallow in a helper reachable through the call
+graph, an uncontained thread target, and a lambda target — plus the
+contained/logged/routing negative cases."""
+
+import logging
+import threading
+
+log = logging.getLogger("corpus")
+
+
+def silent_worker(q):
+    # contained at the top level (outer handler logs), but the INNER
+    # broad handler swallows — the queue consumer dies silently.
+    try:
+        while True:
+            try:
+                q.get()
+            except Exception:  # expect: CST-EXC-001
+                pass
+    except Exception:
+        log.exception("worker died")
+
+
+def swallowing_helper(item):
+    # reachable from contained_worker (a thread target) below
+    try:
+        return item.decode()
+    except Exception:  # expect: CST-EXC-001
+        return None
+
+
+def uncontained_worker(q):  # expect: CST-EXC-002
+    # no top-level try: an exception here kills the thread unlogged
+    item = q.get()
+    return swallowing_helper(item)
+
+
+def contained_worker(q):
+    try:
+        while True:
+            swallowing_helper(q.get())
+    except Exception:
+        log.exception("worker died")
+
+
+def start_all(q):
+    threading.Thread(target=silent_worker, args=(q,)).start()
+    threading.Thread(target=uncontained_worker, args=(q,)).start()
+    threading.Thread(target=contained_worker, args=(q,)).start()
+    threading.Thread(target=lambda: q.get()).start()  # expect: CST-EXC-002
+
+
+# --------------------------------------------------------------------
+# NEGATIVE cases.
+
+
+def unreachable_helper(item):
+    # same swallow shape, but nothing threaded ever reaches it — a
+    # request-path broad except answers to different contracts
+    try:
+        return item.decode()
+    except Exception:
+        return None
+
+
+def routing_worker(q, settle):
+    # the bound exception is ROUTED onward (the _settle_exception /
+    # poison-pill pattern): not a swallow
+    try:
+        while True:
+            q.get()
+    except BaseException as e:
+        settle(e)
+
+
+def start_routing(q, settle):
+    threading.Thread(target=routing_worker, args=(q, settle)).start()

@@ -1,0 +1,91 @@
+# corpus-rules: rng
+"""Seeded PRNG-key-discipline hazards: key reuse (straight-line and
+loop flavors), untracked entropy (wall-clock seeds, free-name keys),
+and rollout token draws outside the row-keyed allowlist — plus the
+negative cases (split chains, fold_in loops, branch arms, module-level
+roots) that must NOT fire."""
+
+import time
+
+import jax
+
+
+def bad_double_draw(key, shape):
+    a = jax.random.uniform(key, shape)
+    b = jax.random.normal(key, shape)  # expect: CST-RNG-001
+    return a + b
+
+
+def bad_loop_reuse(key, xs):
+    out = []
+    for x in xs:
+        out.append(jax.random.normal(key) + x)  # expect: CST-RNG-001
+    return out
+
+
+def bad_wallclock_seed(shape):
+    key = jax.random.PRNGKey(int(time.time()))  # expect: CST-RNG-002
+    return jax.random.uniform(key, shape)
+
+
+def bad_untracked_key(shape):
+    # `mystery_key` is bound nowhere: not a parameter, enclosing
+    # scope, module global, or import.
+    return jax.random.normal(mystery_key, shape)  # expect: CST-RNG-002
+
+
+def bad_rollout_draw(key, logits):
+    # token sampling outside decoding/core.py's row-keyed machinery
+    return jax.random.categorical(key, logits)  # expect: CST-RNG-003
+
+
+def bad_vmapped_rollout(keys, logits):
+    return jax.vmap(jax.random.categorical)(keys, logits)  # expect: CST-RNG-003
+
+
+# --------------------------------------------------------------------
+# NEGATIVE cases: the idiomatic shapes every real call site uses.
+
+
+def ok_split_chain(key, shape):
+    k1, k2 = jax.random.split(key)
+    return jax.random.uniform(k1, shape) + jax.random.normal(k2, shape)
+
+
+def ok_fold_in_loop(key, xs):
+    out = []
+    for i, x in enumerate(xs):
+        k = jax.random.fold_in(key, i)
+        out.append(jax.random.normal(k) + x)
+    return out
+
+
+def ok_branch_arms(key, shape, flag):
+    # mutually exclusive arms: one consumption per execution
+    if flag:
+        return jax.random.uniform(key, shape)
+    else:
+        return jax.random.normal(key, shape)
+
+
+GLOBAL_ROOT = jax.random.PRNGKey(0)
+
+
+def ok_module_level_root(shape):
+    # a deterministic module-level root is tracked entropy
+    return jax.random.uniform(GLOBAL_ROOT, shape)
+
+
+def ok_closure_key(key):
+    def inner(shape):
+        # closure read of the enclosing function's parameter
+        return jax.random.bernoulli(key, 0.5, shape)
+
+    return inner
+
+
+def ok_rederived_key(key, shape):
+    a = jax.random.uniform(key, shape)
+    key = jax.random.split(key)[0]
+    b = jax.random.normal(key, shape)   # fresh binding: no reuse
+    return a + b

@@ -401,3 +401,37 @@ class TestAnalysisReportSchema:
         rec["extra"]["analysis_duration_s"] = True
         with pytest.raises(ValueError, match="analysis_duration_s"):
             validate_record(rec)
+
+    @pytest.mark.parametrize("key", [
+        "analysis_rules_active", "analysis_cache_hit_files",
+        "analysis_findings",
+    ])
+    @pytest.mark.parametrize("bad", [True, False, None, "11", [3]])
+    def test_analysis_extras_must_be_numeric(self, key, bad):
+        """ISSUE 12: every analysis_* extra is a measurement — a
+        bool/None/string value means the preflight didn't actually
+        run/count what the row claims."""
+        rec = good_bench()
+        rec["extra"]["analysis_rules_active"] = 11
+        rec["extra"]["analysis_cache_hit_files"] = 70
+        rec["extra"]["analysis_findings"] = 0
+        validate_record(rec)                 # numeric: fine
+        rec["extra"][key] = bad
+        with pytest.raises(ValueError, match=key):
+            validate_record(rec)
+
+    def test_report_cache_hit_files_bounds(self):
+        """cache_hit_files in the --json report: optional, but when
+        present a non-negative int bounded by files_scanned."""
+        from cst_captioning_tpu.analysis import validate_report
+
+        rec = self.good_report()
+        validate_report(rec)                 # absent: fine (old schema)
+        rec["cache_hit_files"] = 65
+        validate_report(rec)
+        rec["cache_hit_files"] = 66
+        with pytest.raises(ValueError, match="exceeds"):
+            validate_report(rec)
+        rec["cache_hit_files"] = True
+        with pytest.raises(ValueError, match="cache_hit_files"):
+            validate_report(rec)

@@ -744,3 +744,100 @@ class TestObsCheckerSeesRealSites:
                 "the rule went vacuously green"
             )
         assert by_file["serving/slots.py"] >= 3  # dispatch/wait/harvest
+
+
+# ------------------------------------------- ISSUE 12 satellite fixes
+
+
+class TestIssue12ExceptionAndKnobFixes:
+    """Regression pins for the true positives the new analysis
+    families surfaced (each fixed for real, per the PR-8 precedent):
+    CST-CFG-002 on serving.trace_buffer_spans, CST-EXC-002 on the
+    profiler window thread and the SIGTERM shutdown thread."""
+
+    def test_trace_buffer_spans_knob_reaches_the_tracer(self):
+        from cst_captioning_tpu.observability.trace import get_tracer
+        from cst_captioning_tpu.serving.batcher import ContinuousBatcher
+
+        tracer = get_tracer()
+        orig = tracer.buffer_spans
+        try:
+            eng = _StubSlotEngine(S=1)
+            eng.cfg.serving.trace_buffer_spans = 77
+            b = ContinuousBatcher(eng, ServingMetrics())
+            assert b.tracer is tracer
+            assert tracer.buffer_spans == 77
+        finally:
+            tracer.set_buffer_spans(orig)
+
+    def test_set_buffer_spans_rebounds_rings(self):
+        from cst_captioning_tpu.observability.trace import Tracer
+
+        t = Tracer(buffer_spans=8)
+        for i in range(6):
+            t.record("profile", 0.0, 1.0)   # registered span name
+        t.set_buffer_spans(4)
+        assert t.buffer_spans == 4
+        # retired ring re-bounds immediately, keeping newest spans
+        assert t._retired.maxlen == 4
+        # invalid / no-op sizes leave the tracer alone
+        t.set_buffer_spans(0)
+        t.set_buffer_spans(-3)
+        assert t.buffer_spans == 4
+
+    def test_profile_window_failure_releases_flag_and_logs(
+        self, greedy_server, monkeypatch, caplog
+    ):
+        """CST-EXC-002 fix: a start_trace failure must not kill the
+        window thread silently with the 409 flag stuck True."""
+        import logging
+
+        import jax
+
+        def boom(*a, **kw):
+            raise RuntimeError("no profiler on this backend")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace",
+            lambda: (_ for _ in ()).throw(RuntimeError("not tracing")),
+        )
+        srv = greedy_server
+        with caplog.at_level(
+            logging.ERROR, logger="cst_captioning_tpu.serving"
+        ):
+            status, _, body = _get(srv.url + "/debug/profile?ms=50")
+            assert status == 202
+            for _ in range(200):
+                if not srv._http._profiling:
+                    break
+                time.sleep(0.01)
+        assert not srv._http._profiling, (
+            "window flag stuck True after a start_trace failure — "
+            "every later /debug/profile would 409 forever"
+        )
+        assert any(
+            "profiler window failed" in r.message for r in caplog.records
+        )
+
+    def test_sigterm_shutdown_wrapper_logs_not_raises(self, caplog):
+        """CST-EXC-002 fix: the SIGTERM thread targets
+        _signal_shutdown, which contains and logs shutdown failures."""
+        import logging
+
+        from cst_captioning_tpu.serving.server import CaptionServer
+
+        srv = CaptionServer.__new__(CaptionServer)
+
+        def broken_shutdown(drain=True):
+            raise RuntimeError("teardown exploded")
+
+        srv.shutdown = broken_shutdown
+        with caplog.at_level(
+            logging.ERROR, logger="cst_captioning_tpu.serving"
+        ):
+            srv._signal_shutdown()     # must not raise
+        assert any(
+            "SIGTERM shutdown failed" in r.message
+            for r in caplog.records
+        )
