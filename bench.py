@@ -50,6 +50,9 @@ _REPS size it), BENCH_SLO=0 to skip the chaos-soak/SLO-attainment
 rows (subprocess CPU child; BENCH_SLO_SEED / _REQS size it — the
 slo_reference_attainment row feeds the SLO regression gate, which
 exits 3 on a pinned-threshold breach),
+BENCH_COLDSTART=0 to skip the paired warm-vs-AOT replica cold-start
+rows (subprocess CPU child spawning one fresh process per boot arm;
+BENCH_COLDSTART_SLOTS sizes the slot bank),
 BENCH_RNG to override the PRNG impl,
 BENCH_ATT_HIDDEN to override model.att_hidden_size (A-width sweeps),
 BENCH_CST_OVERLAP=0 to skip the unchunked-CST comparison re-run,
@@ -171,6 +174,14 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
                     f"{k!r} must be an attainment fraction in [0, 1], "
                     f"got {v!r}"
                 )
+        # Cold-start rows (ISSUE 13): every coldstart_* field is a
+        # measurement by contract — numeric, never bool/None/prose.
+        # The paired warm-vs-AOT rows are only comparable when both
+        # processes really booted and served (a missing side must fail
+        # the emit, not ship as prose).
+        for k, v in rec["extra"].items():
+            if k.startswith("coldstart_") and not _is_number(v):
+                fail(f"{k!r} must be a real number, got {v!r}")
         # Analysis-preflight provenance (ISSUE 12): every analysis_*
         # extra is a measurement by contract — finding/rule/file
         # counts and durations are numbers, never bool/None/prose
@@ -1765,6 +1776,176 @@ def bench_slo():
     return json.loads(lines[-1])
 
 
+def _coldstart_serve_once():
+    """Grandchild body (BENCH_COLDSTART_MODE=warm|aot): boot a replica
+    from the artifact's params — warm-compiling the whole ladder, or
+    installing the artifact's pre-compiled executables — then serve ONE
+    caption through the slot loop.  Prints internal timings + the
+    decoded tokens; the PARENT measures total process wall (spawn ->
+    line), which is the honest process-start -> first-caption metric
+    (both arms pay the same interpreter/import tax)."""
+    import numpy as np
+
+    from cst_captioning_tpu.config import Config
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.serving.artifact import (
+        _resolve_version_dir,
+        load_manifest,
+    )
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    mode = os.environ["BENCH_COLDSTART_MODE"]
+    vdir = _resolve_version_dir(os.environ["BENCH_COLDSTART_ARTIFACT"])
+    t0 = time.perf_counter()
+    if mode == "aot":
+        eng = InferenceEngine.from_artifact(vdir)
+        dec = eng.slot_decoder()
+    else:
+        man = load_manifest(vdir)
+        cfg = Config.from_dict(man["config"])
+        cfg.serving.warmup = True     # the full trace+compile ladder
+        vocab = Vocabulary.load(os.path.join(vdir, "vocab.json"))
+        eng = InferenceEngine(cfg, checkpoint=vdir, vocab=vocab)
+        dec = eng.slot_decoder()
+    t_boot = time.perf_counter()
+    rng = np.random.RandomState(0)
+    d = eng.cfg.data
+    payload = {
+        "features": {
+            m: rng.randn(d.max_frames, d.feature_dims[m]).astype(
+                np.float32
+            )
+            for m in d.feature_modalities
+        }
+    }
+    req = eng.prepare(payload)
+    done = dec.tick([req], ["coldstart"])
+    while not done:
+        done = dec.tick()
+    _, tokens, _, _ = dec.harvest_many(done)[0]
+    print(json.dumps({
+        "boot_s": round(t_boot - t0, 4),
+        "first_decode_s": round(time.perf_counter() - t_boot, 4),
+        "compile_count": dec.compile_count,
+        "tokens": [int(t) for t in tokens],
+    }), flush=True)
+
+
+def _bench_coldstart_impl():
+    """Paired cold-start rows (ISSUE 13): process start -> first
+    caption served, WARM-compile vs AOT artifact boot, measured on
+    fresh subprocesses over the SAME artifact params (the warm arm
+    restores the artifact's orbax item as a checkpoint).  Plus the
+    artifact build time / on-disk bytes and the compile_count == 0 pin
+    carried as a measured field.  Smoke shape on the CPU backend —
+    `coldstart_host_cores` records the caveat; the RATIO is the
+    portable number (both arms pay identical interpreter/import and
+    decode costs, the delta is the compile ladder)."""
+    import shutil
+    import subprocess
+    import tempfile
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.serving.artifact import build_artifact
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    out_root = tempfile.mkdtemp(prefix="bench_coldstart_")
+    try:
+        cfg = get_preset("synthetic_smoke")
+        cfg.serving.warmup = False
+        cfg.serving.num_slots = int(
+            os.environ.get("BENCH_COLDSTART_SLOTS", "4")
+        )
+        cfg.serving.slot_bank_min = 2
+        vocab = Vocabulary([f"w{i}" for i in range(252)])
+        cfg.model.vocab_size = len(vocab)
+        engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+        summary = build_artifact(engine, out_root)
+
+        here = os.path.abspath(__file__)
+
+        def run_mode(mode):
+            env = dict(os.environ)
+            env["JAX_PLATFORMS"] = "cpu"
+            env["BENCH_COLDSTART_MODE"] = mode
+            env["BENCH_COLDSTART_ARTIFACT"] = summary["path"]
+            env.pop("BENCH_COLDSTART_CHILD", None)
+            t0 = time.perf_counter()
+            r = subprocess.run(
+                [sys.executable, here],
+                capture_output=True, text=True, timeout=900, env=env,
+                cwd=os.path.dirname(here),
+            )
+            wall = time.perf_counter() - t0
+            lines = [
+                ln for ln in r.stdout.strip().splitlines()
+                if ln.startswith("{")
+            ]
+            if r.returncode != 0 or not lines:
+                tail = (r.stderr or r.stdout).strip().splitlines()
+                raise RuntimeError(
+                    f"coldstart {mode} child rc={r.returncode}: "
+                    f"{tail[-1] if tail else 'no output'}"
+                )
+            return wall, json.loads(lines[-1])
+
+        warm_wall, warm = run_mode("warm")
+        aot_wall, aot = run_mode("aot")
+        return {
+            "coldstart_host_cores": float(os.cpu_count() or 1),
+            "coldstart_warm_s": round(warm_wall, 3),
+            "coldstart_aot_s": round(aot_wall, 3),
+            "coldstart_ratio": round(warm_wall / max(aot_wall, 1e-9), 3),
+            "coldstart_warm_boot_s": round(warm["boot_s"], 3),
+            "coldstart_aot_boot_s": round(aot["boot_s"], 3),
+            "coldstart_warm_compile_count": float(warm["compile_count"]),
+            "coldstart_aot_compile_count": float(aot["compile_count"]),
+            "coldstart_artifact_build_s": round(summary["build_s"], 3),
+            "coldstart_artifact_bytes": float(summary["artifact_bytes"]),
+            "coldstart_variants": float(
+                summary["variants"] + summary["encode_variants"]
+            ),
+            "coldstart_tokens_match": (
+                1.0 if warm["tokens"] == aot["tokens"] else 0.0
+            ),
+        }
+    finally:
+        shutil.rmtree(out_root, ignore_errors=True)
+
+
+def bench_coldstart():
+    """Cold-start rows (see :func:`_bench_coldstart_impl`).  Re-execs
+    into a CPU subprocess (the bench_slo precedent) — the artifact
+    build and both boot arms target the smoke shape and must not
+    disturb the TPU-held parent."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_COLDSTART_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=1800, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"coldstart child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    return json.loads(lines[-1])
+
+
 def _bench_slot_mem_impl():
     """Paired REPLICATED-vs-DEDUPED decode-state memory rows (ISSUE 7).
 
@@ -2901,6 +3082,16 @@ def main() -> int:
             errors["slo_gate"] = gate_reason
             print(f"SLO GATE FAILED: {gate_reason}", file=sys.stderr)
         emit()
+    if os.environ.get("BENCH_COLDSTART", "1") == "1":
+        # Paired warm-vs-AOT cold-start rows (ISSUE 13): process start
+        # -> first caption served, measured on fresh subprocesses over
+        # one shared artifact (CPU child; degraded-mode safe).  The
+        # coldstart_ratio row is the elastic-fleet acceptance number.
+        try:
+            extra.update(bench_coldstart())
+        except Exception as e:  # noqa: BLE001
+            extra["coldstart_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if os.environ.get("BENCH_SHARD", "1") == "1":
         # Paired replicated-vs-model-sharded XE rows on a >=4-device
         # mesh (ISSUE 9): inline on multi-device hosts, re-exec'd onto
@@ -2988,6 +3179,18 @@ if __name__ == "__main__":
         # Re-exec'd chaos-soak/SLO child (bench_slo).
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_slo_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_COLDSTART_MODE"):
+        # Cold-start GRANDCHILD: one fresh process booting warm or from
+        # the artifact, serving one caption (bench_coldstart).
+        jax.config.update("jax_platforms", "cpu")
+        _coldstart_serve_once()
+        sys.exit(0)
+    if os.environ.get("BENCH_COLDSTART_CHILD") == "1":
+        # Re-exec'd cold-start child (bench_coldstart): builds the
+        # artifact and times both boot arms as subprocesses.
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_coldstart_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_TRACE_CHILD") == "1":
         # Re-exec'd tracing-on/off serving child (bench_trace_overhead).

@@ -15,10 +15,21 @@ Two contracts from the perf PRs:
   requires every jit site in the package to be registered in
   ``jit_registry.py`` with an expected retrace budget; CST-DON-003
   flags stale registry entries so the registry cannot rot.
+* **AOT discipline** (PR 13, the serving-artifact subsystem): a
+  ``.lower(...).compile(...)`` chain compiles OUTSIDE the jit dispatch
+  path, and ``deserialize_and_load`` installs an executable that was
+  compiled in ANOTHER process — both bypass every runtime retrace
+  guard, so each such site must be registered in
+  ``jit_registry.py::AOT_SITE_REGISTRY`` with the story of what
+  enumerates its variants and what refuses a stale/foreign executable
+  (CST-DON-004); CST-DON-005 flags stale AOT entries (the DON-003 rot
+  guard applied to the AOT registry).
 
 Site keys are ``<file>::<qualname>`` (decorated defs) or
 ``<file>::<enclosing qualname>::<target>`` (jit-by-call) — stable under
-reformatting, unlike line numbers.
+reformatting, unlike line numbers.  AOT sites key on the enclosing
+qualname alone (one entry covers a function's whole lower/compile
+loop).
 """
 
 from __future__ import annotations
@@ -115,6 +126,91 @@ def collect_jit_sites(
     return sites
 
 
+# AOT executable production/installation shapes (CST-DON-004): the
+# `<lowered>.compile()` chain and the cross-process executable loader.
+_AOT_LOADERS = {"deserialize_and_load"}
+
+
+def _is_chained_lower_compile(node: ast.Call) -> bool:
+    """``<expr>.lower(...).compile(...)`` — compilation outside the jit
+    dispatch path (the AOT artifact builder's shape)."""
+    f = node.func
+    return (
+        isinstance(f, ast.Attribute)
+        and f.attr == "compile"
+        and isinstance(f.value, ast.Call)
+        and isinstance(f.value.func, ast.Attribute)
+        and f.value.func.attr == "lower"
+    )
+
+
+def _produces_lowerings(fn_node: ast.AST) -> bool:
+    """Whether a function body contains a lowering producer: an ARGFUL
+    ``.lower(...)`` call (jax lowering always takes avals — ``str.lower()``
+    takes none) or a call into the ``aot_lower*`` enumeration API."""
+    for n in ast.walk(fn_node):
+        if not isinstance(n, ast.Call):
+            continue
+        f = n.func
+        if isinstance(f, ast.Attribute):
+            if f.attr == "lower" and (n.args or n.keywords):
+                return True
+            if f.attr.startswith("aot_lower"):
+                return True
+        elif isinstance(f, ast.Name) and f.id.startswith("aot_lower"):
+            return True
+    return False
+
+
+def _is_lowered_compile(node: ast.Call, mi: ModuleInfo) -> bool:
+    """The chained shape, or ``<name>.compile(...)`` inside a function
+    that produces lowerings (the builder keeps lowering and compiling in
+    separate expressions — the def-use-free, deterministic
+    approximation)."""
+    if _is_chained_lower_compile(node):
+        return True
+    f = node.func
+    if not (
+        isinstance(f, ast.Attribute)
+        and f.attr == "compile"
+        and isinstance(f.value, ast.Name)
+    ):
+        return False
+    qn = mi.qualname_of(node)
+    fn = mi.functions.get(qn)
+    return fn is not None and _produces_lowerings(fn.node)
+
+
+def _is_executable_load(node: ast.Call) -> bool:
+    """``deserialize_and_load(...)`` (any alias path) — installing an
+    executable compiled in another process."""
+    name = call_name(node) or ""
+    return name.rsplit(".", 1)[-1] in _AOT_LOADERS
+
+
+def collect_aot_sites(
+    modules: List[ModuleInfo],
+) -> List[Tuple[str, ModuleInfo, ast.Call, str]]:
+    """Every AOT compile/install site as
+    ``(site_key, module, call, kind)`` — keyed on the enclosing
+    qualname (one registry entry covers a function's variant loop)."""
+    sites: List[Tuple[str, ModuleInfo, ast.Call, str]] = []
+    for mi in modules:
+        for node in ast.walk(mi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _is_lowered_compile(node, mi):
+                kind = "lowered-compile"
+            elif _is_executable_load(node):
+                kind = "executable-load"
+            else:
+                continue
+            sites.append(
+                (f"{mi.rel}::{mi.qualname_of(node)}", mi, node, kind)
+            )
+    return sites
+
+
 @register_checker("donation")
 def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
     out: List[Finding] = []
@@ -152,5 +248,26 @@ def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
                 "CST-DON-003", "analysis/jit_registry.py", 1, key,
                 f"stale jit-registry entry `{key}` matches no site — "
                 "the code moved; update or remove the entry",
+            ))
+    # ---- AOT lowered/compiled + executable-install coverage (PR 13)
+    seen_aot = set()
+    for key, mi, call, kind in collect_aot_sites(modules):
+        seen_aot.add(key)
+        if key not in jit_registry.AOT_SITE_REGISTRY:
+            out.append(Finding(
+                "CST-DON-004", mi.rel, call.lineno,
+                mi.qualname_of(call),
+                f"AOT {kind} site `{key}` is not registered — add it "
+                "to analysis/jit_registry.py::AOT_SITE_REGISTRY with "
+                "the story of what enumerates its variants and what "
+                "refuses a stale or foreign executable",
+            ))
+    for key in sorted(jit_registry.AOT_SITE_REGISTRY):
+        if key not in seen_aot:
+            out.append(Finding(
+                "CST-DON-005", "analysis/jit_registry.py", 1, key,
+                f"stale AOT-registry entry `{key}` matches no "
+                "lower/compile or executable-load site — the code "
+                "moved; update or remove the entry",
             ))
     return out

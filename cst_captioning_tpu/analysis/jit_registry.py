@@ -138,6 +138,38 @@ JIT_SITE_REGISTRY: Dict[str, JitSite] = {
 }
 
 
+# Every AOT compile/install site in the package (PR 13): the
+# ``.lower(...).compile(...)`` chain compiles OUTSIDE the jit dispatch
+# path and ``deserialize_and_load`` installs an executable compiled in
+# ANOTHER process — both bypass the runtime retrace guards above, so
+# CST-DON-004 requires each such site (keyed ``<file>::<qualname>``) to
+# state what enumerates its variants and what refuses a stale or
+# foreign executable; CST-DON-005 flags stale entries.
+AOT_SITE_REGISTRY: Dict[str, str] = {
+    "serving/artifact.py::build_artifact": (
+        "artifact builder: compiles exactly the variants "
+        "SlotDecoder.aot_lower / InferenceEngine.aot_lower_encode "
+        "enumerate (the same ladder code warmup walks), through the "
+        "persistent compilation cache pointed into the artifact; the "
+        "manifest records a sha256 HLO key per variant"
+    ),
+    "serving/artifact.py::load_engine": (
+        "artifact loader: deserializes only after the manifest's "
+        "schema/jax/jaxlib/device/version fields AND the re-derived "
+        "variant key set match the live environment exactly "
+        "(ArtifactMismatchError otherwise — refusal, never a silent "
+        "retrace); installed via SlotDecoder.aot_install with "
+        "compile_count == 0 pinned in tier-1"
+    ),
+    "serving/slots.py::_slot_runner": (
+        "shared parity harness's artifact-boot backend: compiles a "
+        "builder decoder's aot_lower variants and installs them into a "
+        "fresh decoder, pinning compile_count == 0 plus token-exactness "
+        "vs the scan reference (tests/test_decode_core.py)"
+    ),
+}
+
+
 # Every ``with_sharding_constraint`` site in the package (and every call
 # through ``parallel/partition.py::constrain``), keyed
 # ``<file>::<enclosing qualname>`` — CST-SHD-002 fails the pass on any

@@ -1,0 +1,73 @@
+"""Build an AOT serving artifact (the elastic-fleet deploy unit):
+
+  python -m cst_captioning_tpu.cli.build_artifact \\
+      --preset msrvtt_serve_beam5 \\
+      --checkpoint checkpoints/msrvtt_cst_ms_scb/best \\
+      --out artifacts/msrvtt_serve
+
+Loads the checkpoint once, enumerates every (slot-bank, admit-bucket,
+transition) tick variant the serving warmup would compile — from the
+SAME ladder code, so artifact and warmup can never drift — compiles
+them ahead of time (``jax.jit(...).lower().compile()`` through the
+persistent compilation cache), and publishes a versioned artifact
+directory (manifest + orbax params + vocab + serialized executables +
+the populated cache dir) atomically under ``--out``.  A replica then
+boots from it with ``cli/serve.py --artifact <dir>`` (or
+``InferenceEngine.from_artifact``) with ZERO fresh tick compiles —
+see docs/SERVING.md "Artifacts & elastic scaling".
+
+Prints one JSON line: artifact path, version, build seconds, on-disk
+bytes, variant counts.  ``--random-init`` builds from fresh weights
+(load-test artifacts; the captions are noise).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from cst_captioning_tpu.config import parse_cli
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--checkpoint", default="")
+    parser.add_argument(
+        "--random-init", action="store_true",
+        help="build from random weights (load-test artifacts only)",
+    )
+    parser.add_argument(
+        "--out", required=True,
+        help="artifact root directory (versions publish beneath it)",
+    )
+    known, rest = parser.parse_known_args(argv)
+    cfg = parse_cli(rest)
+    if not known.checkpoint and not known.random_init:
+        print(
+            "build_artifact: need --checkpoint PATH (or --random-init "
+            "for a weights-free load-test artifact)",
+            file=sys.stderr,
+        )
+        return 2
+
+    from cst_captioning_tpu.serving.artifact import build_artifact
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    # The builder compiles the ladder itself (aot_lower); ctor warmup
+    # would compile everything a second time for nothing.
+    cfg.serving.warmup = False
+    engine = InferenceEngine(
+        cfg,
+        checkpoint=known.checkpoint,
+        random_init=known.random_init,
+    )
+    summary = build_artifact(engine, known.out)
+    print(json.dumps(summary), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

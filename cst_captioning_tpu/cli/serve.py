@@ -19,6 +19,15 @@ within ``--serving.drain_timeout_s``.
 
 ``--random-init`` serves freshly-initialized weights instead of a
 checkpoint (load testing / smoke runs only — the captions are noise).
+
+``--artifact DIR`` boots from an AOT serving artifact
+(cli/build_artifact.py) instead of warm-compiling: the manifest is
+validated against the live environment (refusal on any mismatch) and
+every tick-ladder variant loads pre-compiled — second-scale replica
+birth, zero fresh compiles (docs/SERVING.md "Artifacts & elastic
+scaling").  The artifact carries its own (build-time) config — decode
+and ladder knobs are baked into the compiled executables; only the
+HTTP-layer ``--serving.host`` / ``--serving.port`` flags apply on top.
 """
 
 from __future__ import annotations
@@ -38,12 +47,17 @@ def main(argv=None) -> int:
         "--random-init", action="store_true",
         help="serve random weights (load testing only)",
     )
+    parser.add_argument(
+        "--artifact", default="",
+        help="boot from an AOT serving artifact (cli/build_artifact.py) "
+             "— zero fresh tick compiles at startup",
+    )
     known, rest = parser.parse_known_args(argv)
     cfg = parse_cli(rest)
-    if not known.checkpoint and not known.random_init:
+    if not known.checkpoint and not known.random_init and not known.artifact:
         print(
-            "serve: need --checkpoint PATH (or --random-init for a "
-            "weights-free load-test server)",
+            "serve: need --checkpoint PATH, --artifact DIR, or "
+            "--random-init for a weights-free load-test server",
             file=sys.stderr,
         )
         return 2
@@ -51,11 +65,18 @@ def main(argv=None) -> int:
     from cst_captioning_tpu.serving.engine import InferenceEngine
     from cst_captioning_tpu.serving.server import CaptionServer
 
-    engine = InferenceEngine(
-        cfg,
-        checkpoint=known.checkpoint,
-        random_init=known.random_init,
-    )
+    if known.artifact:
+        engine = InferenceEngine.from_artifact(known.artifact)
+        # The artifact bakes the decode/ladder config; only the
+        # HTTP-layer bind address applies from the command line.
+        engine.cfg.serving.host = cfg.serving.host
+        engine.cfg.serving.port = cfg.serving.port
+    else:
+        engine = InferenceEngine(
+            cfg,
+            checkpoint=known.checkpoint,
+            random_init=known.random_init,
+        )
     server = CaptionServer(engine)
     if hasattr(server.batcher, "replicas"):
         logging.getLogger("cst_captioning_tpu.serving").info(

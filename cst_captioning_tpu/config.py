@@ -397,6 +397,23 @@ class ServingConfig:
     # by what it actually holds, not how many entries it has; evictions
     # are counted and exported on /metrics.
     feature_cache_bytes: int = 0
+    # Elastic autoscaler (serving/autoscaler.py).  Empty dict = OFF: no
+    # control loop is constructed and the fleet is statically sized (the
+    # pre-PR-13 behavior, byte-identical).  Keys (all optional, see
+    # AutoscaleConfig for defaults/semantics): "min_replicas",
+    # "max_replicas", "window_ticks", "scale_up_queue_depth",
+    # "scale_up_shed", "scale_up_wait_p99_ms", "scale_down_occupancy",
+    # "cooldown_ticks", "interval_s".  Decisions are a deterministic
+    # function of the observed signal window (pinned by the PR-11
+    # virtual-time replay tests); each applied decision lands as an
+    # `autoscale` flight event and on the caption_autoscale_* metric
+    # families.
+    autoscale: Dict[str, Any] = field(default_factory=dict)
+    # AOT serving artifacts (serving/artifact.py): how many artifact
+    # VERSIONS the loader keeps on disk per artifact root.  Loading an
+    # artifact garbage-collects older version directories beyond this
+    # count — the ACTIVE (just-loaded) version is never collected.
+    artifact_keep: int = 2
     warmup: bool = True           # pre-jit the whole ladder at startup
 
 

@@ -122,6 +122,11 @@ EVENT_CATALOGUE: List[Tuple[str, str, str]] = [
     ("chaos_fault", "flight",
      "a ChaosEngine injection fired at a registered FAULT_SITES site "
      "(serving/chaos.py; site + parameters in the tags)"),
+    ("autoscale", "flight",
+     "an elastic-autoscaler decision was applied (serving/autoscaler.py:"
+     " action, reason, and the before/after replica counts in the tags;"
+     " scale-downs additionally leave the PR-4 kill/drain events on the"
+     " drained replica's own ring)"),
 ]
 
 _ALL_PATTERNS = [p for p, _, _ in SPAN_CATALOGUE + EVENT_CATALOGUE]

@@ -1,0 +1,442 @@
+"""AOT serving artifacts: kill cold start, make replicas disposable.
+
+A replica is born today by warmup-compiling every (slot-bank,
+admit-bucket, transition) tick variant — PR 7 made *regrow* free but
+left *boot* paying the full trace+compile ladder, which is exactly what
+makes elastic fleets unreal: autoscaling only works when adding a
+replica is cheap.  This module turns the compiled decode ladder into a
+**versioned on-disk deploy unit** (the "Compiler-First State Space
+Duality and Portable O(1) Autoregressive Caching" framing in PAPERS.md:
+compiled decode state as a portable artifact):
+
+```
+<root>/<artifact_version>/          (published atomically: tmp + rename)
+  manifest.json     schema version, fingerprint block (params_tag /
+                    mesh_shape / preset / package version), jax/jaxlib
+                    versions + device kind, per-variant cache keys
+                    (sha256 of the lowered HLO), the full Config
+  params/           orbax params item (the cli/test.py restore format —
+                    an artifact IS a loadable checkpoint)
+  vocab.json        the engine's vocabulary
+  executables.pkl   {variant key -> serialized compiled executable}
+  xla_cache/        the persistent compilation cache populated by the
+                    build's `.lower().compile()` calls
+                    (jax_compilation_cache_dir)
+```
+
+**Build** (:func:`build_artifact`, ``cli/build_artifact.py``): every
+variant ``warmup()`` would compile is enumerated by the SAME ladder code
+(``SlotDecoder.aot_variant_keys`` / ``aot_lower`` +
+``aot_encode_buckets`` for the admission encode), lowered at its exact
+runtime shapes, compiled through the persistent compilation cache
+pointed INTO the artifact, and serialized
+(``jax.experimental.serialize_executable``).  The artifact version is a
+content hash over (fingerprint, environment, per-variant HLO keys), so
+rebuilding an unchanged engine is a no-op and two hosts building the
+same deploy agree on the version string.
+
+**Load** (:func:`load_engine`, ``InferenceEngine.from_artifact``): the
+manifest is validated FIELD BY FIELD against the live environment —
+any mismatch raises :class:`ArtifactMismatchError` naming every
+divergent field (a refusal, never a silent retrace) — then params
+restore via orbax, the variant key set is re-derived from the live
+ladder code and checked against the manifest (drift refusal), and every
+executable is deserialized and installed.  The booted engine's slot
+decoder has ``compile_count == 0``: zero tick-ladder traces, zero XLA
+compiles, second-scale replica birth (the paired ``coldstart_*`` bench
+rows measure it).  Loading also garbage-collects artifact versions
+beyond ``serving.artifact_keep`` — the active version is never
+collected (:func:`prune_artifacts`).
+
+Parity (docs/PARITY.md): an artifact-booted replica cannot change any
+token — the installed executables ARE the programs warmup would have
+compiled (same lowering, same shapes, same XLA pipeline); only the
+compilation moved in time.  Pinned by the ``slot_decoder_beam_aot``
+shared-harness backend and the warm-vs-artifact token test in
+tests/test_artifact.py.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import pickle
+import shutil
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+_log = logging.getLogger("cst_captioning_tpu.serving")
+
+ARTIFACT_SCHEMA_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+_EXEC_NAME = "executables.pkl"
+_CACHE_DIR = "xla_cache"
+_VOCAB_NAME = "vocab.json"
+_TMP_PREFIX = ".tmp-"
+
+# The manifest fields compared field-by-field against the live
+# environment before anything is deserialized.
+_ENV_FIELDS = ("jax_version", "jaxlib_version", "platform", "device_kind")
+
+
+class ArtifactError(ValueError):
+    """Malformed or unreadable artifact (missing manifest, bad schema
+    payload, truncated executables)."""
+
+
+class ArtifactMismatchError(ArtifactError):
+    """The refusal contract: the manifest does not match the live
+    environment/engine.  Carries every divergent field as
+    ``(field, artifact_value, live_value)`` — the loader never guesses,
+    never retraces, and the error names exactly what moved."""
+
+    def __init__(self, mismatches: List[Tuple[str, Any, Any]]):
+        self.mismatches = list(mismatches)
+        detail = "; ".join(
+            f"{f}: artifact={a!r} live={b!r}"
+            for f, a, b in self.mismatches
+        )
+        super().__init__(
+            f"artifact refused — {len(self.mismatches)} manifest field(s) "
+            f"mismatch the live environment: {detail}"
+        )
+
+
+def environment_block() -> Dict[str, str]:
+    """The live-environment half of the refusal contract."""
+    dev = jax.devices()[0]
+    return {
+        "jax_version": jax.__version__,
+        "jaxlib_version": getattr(jax.lib, "__version__", jax.__version__),
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+    }
+
+
+@contextlib.contextmanager
+def _compilation_cache(path: str):
+    """Point jax's persistent compilation cache at ``path`` for the
+    duration (min-compile-time/entry-size floors dropped so every ladder
+    variant lands on disk), restoring the previous configuration after —
+    builds and loads must not leave a global cache redirect behind."""
+    old_dir = jax.config.jax_compilation_cache_dir
+    old_min_t = jax.config.jax_persistent_cache_min_compile_time_secs
+    old_min_b = jax.config.jax_persistent_cache_min_entry_size_bytes
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", old_min_t
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_entry_size_bytes", old_min_b
+        )
+
+
+def _hlo_key(lowered) -> str:
+    """Per-variant cache key: sha256 of the lowered (pre-optimization)
+    HLO text — stable across processes for an unchanged program, so the
+    manifest records WHAT each executable computes, not where."""
+    return hashlib.sha256(lowered.as_text().encode()).hexdigest()[:16]
+
+
+def artifact_bytes(path: str) -> int:
+    """Total on-disk bytes of one artifact version (the bench
+    ``coldstart_artifact_bytes`` row)."""
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            total += os.path.getsize(os.path.join(root, f))
+    return total
+
+
+# ------------------------------------------------------------------ build
+
+def build_artifact(engine, out_root: str) -> Dict[str, Any]:
+    """Precompile ``engine``'s entire tick ladder ahead of time and
+    publish it as a versioned artifact under ``out_root`` (see module
+    doc for the layout).  Atomic: everything is written into a
+    ``.tmp-*`` sibling and ``os.replace``d into place, so a crashed
+    build leaves no half-artifact a loader could trust.  Rebuilding an
+    unchanged engine finds its content-hash version already published
+    and returns without writing (``rebuilt: False``)."""
+    import orbax.checkpoint as ocp
+
+    from jax.experimental import serialize_executable as se
+
+    t0 = time.perf_counter()
+    decoder = engine.slot_decoder()
+    lowered = decoder.aot_lower()
+    lowered += engine.aot_lower_encode(decoder.aot_encode_buckets())
+    variant_keys = {
+        k: _hlo_key(low) for k, low in lowered
+        if not k.startswith("encode:")
+    }
+    encode_keys = {
+        k: _hlo_key(low) for k, low in lowered if k.startswith("encode:")
+    }
+    fp = dict(engine.fingerprint())
+    fp.pop("artifact_version", None)  # the artifact NAMES the version
+    core = {
+        "schema": ARTIFACT_SCHEMA_VERSION,
+        "fingerprint": fp,
+        "env": environment_block(),
+        "variants": variant_keys,
+        "encode_variants": encode_keys,
+    }
+    version = "v" + hashlib.sha256(
+        json.dumps(core, sort_keys=True).encode()
+    ).hexdigest()[:12]
+    os.makedirs(out_root, exist_ok=True)
+    final = os.path.join(out_root, version)
+    if os.path.exists(os.path.join(final, MANIFEST_NAME)):
+        _log.info("artifact %s already published — reusing", final)
+        return {
+            "path": final,
+            "artifact_version": version,
+            "rebuilt": False,
+            "build_s": time.perf_counter() - t0,
+            "artifact_bytes": artifact_bytes(final),
+            "variants": len(variant_keys),
+            "encode_variants": len(encode_keys),
+        }
+    tmp = os.path.join(out_root, f"{_TMP_PREFIX}{version}-{os.getpid()}")
+    try:
+        os.makedirs(tmp)
+        # Compile every variant THROUGH the persistent cache pointed
+        # into the artifact: the cache dir ships with it, so any
+        # residual compile at load is a disk hit, not a fresh XLA run.
+        with _compilation_cache(os.path.join(tmp, _CACHE_DIR)):
+            compiled = {k: low.compile() for k, low in lowered}
+        payloads = {k: se.serialize(c) for k, c in compiled.items()}
+        with open(os.path.join(tmp, _EXEC_NAME), "wb") as f:
+            pickle.dump(payloads, f)
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(
+            os.path.abspath(os.path.join(tmp, "params")),
+            engine.params,
+            force=True,
+        )
+        ckptr.wait_until_finished()
+        engine.vocab.save(os.path.join(tmp, _VOCAB_NAME))
+        manifest = dict(
+            core,
+            artifact_version=version,
+            config=engine.cfg.to_dict(),
+            built_utc=time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+        )
+        with open(os.path.join(tmp, MANIFEST_NAME), "w") as f:
+            json.dump(manifest, f, indent=1, sort_keys=True)
+        os.replace(tmp, final)
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+    build_s = time.perf_counter() - t0
+    _log.info(
+        "artifact %s published: %d tick + %d encode variants, %.1fs",
+        final, len(variant_keys), len(encode_keys), build_s,
+    )
+    return {
+        "path": final,
+        "artifact_version": version,
+        "rebuilt": True,
+        "build_s": build_s,
+        "artifact_bytes": artifact_bytes(final),
+        "variants": len(variant_keys),
+        "encode_variants": len(encode_keys),
+    }
+
+
+# ------------------------------------------------------------------- load
+
+def _resolve_version_dir(path: str) -> str:
+    """``path`` may be a version dir (manifest present) or an artifact
+    root — then the NEWEST published version is picked."""
+    if os.path.exists(os.path.join(path, MANIFEST_NAME)):
+        return path
+    if not os.path.isdir(path):
+        raise ArtifactError(f"no artifact at {path!r}")
+    versions = [
+        os.path.join(path, d) for d in os.listdir(path)
+        if not d.startswith(_TMP_PREFIX)
+        and os.path.exists(os.path.join(path, d, MANIFEST_NAME))
+    ]
+    if not versions:
+        raise ArtifactError(
+            f"{path!r} holds no published artifact version (a crashed "
+            "build leaves only .tmp-* dirs, which are never loaded)"
+        )
+    return max(versions, key=os.path.getmtime)
+
+
+def load_manifest(version_dir: str) -> Dict[str, Any]:
+    p = os.path.join(version_dir, MANIFEST_NAME)
+    try:
+        with open(p) as f:
+            man = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise ArtifactError(f"unreadable manifest {p!r}: {e}") from e
+    for key in (
+        "schema", "fingerprint", "env", "variants", "encode_variants",
+        "artifact_version", "config",
+    ):
+        if key not in man:
+            raise ArtifactError(f"manifest {p!r} missing key {key!r}")
+    return man
+
+
+def _check_environment(man: Dict[str, Any]) -> None:
+    """Pre-deserialization refusal: schema, toolchain, device, package
+    version — every divergent field reported at once."""
+    from cst_captioning_tpu import __version__
+
+    mm: List[Tuple[str, Any, Any]] = []
+    if man["schema"] != ARTIFACT_SCHEMA_VERSION:
+        mm.append(("schema", man["schema"], ARTIFACT_SCHEMA_VERSION))
+    env = environment_block()
+    for f in _ENV_FIELDS:
+        if man["env"].get(f) != env[f]:
+            mm.append((f"env.{f}", man["env"].get(f), env[f]))
+    if man["fingerprint"].get("version") != __version__:
+        mm.append((
+            "fingerprint.version",
+            man["fingerprint"].get("version"),
+            __version__,
+        ))
+    if mm:
+        raise ArtifactMismatchError(mm)
+
+
+def load_engine(path: str, engine_cls=None, replica_id=None):
+    """Boot an :class:`InferenceEngine` from an artifact with ZERO fresh
+    tick-ladder traces or compiles (see module doc).  The engine's slot
+    decoder reports ``compile_count == 0`` after this returns — the
+    tier-1 pin that the boot really was ahead-of-time."""
+    from jax.experimental import serialize_executable as se
+
+    from cst_captioning_tpu.config import Config
+    from cst_captioning_tpu.data.vocab import Vocabulary
+
+    if engine_cls is None:
+        from cst_captioning_tpu.serving.engine import InferenceEngine
+
+        engine_cls = InferenceEngine
+    vdir = _resolve_version_dir(path)
+    man = load_manifest(vdir)
+    _check_environment(man)
+    cfg = Config.from_dict(man["config"])
+    # The ladder is installed, not warmed — ctor warmup would rebuild
+    # (and recompile) what the artifact already carries.
+    cfg.serving.warmup = False
+    vocab = Vocabulary.load(os.path.join(vdir, _VOCAB_NAME))
+    fp = man["fingerprint"]
+    with _compilation_cache(os.path.join(vdir, _CACHE_DIR)):
+        engine = engine_cls(cfg, checkpoint=vdir, vocab=vocab)
+        # The artifact serves ONE logical model: replicas booted from it
+        # share the build-time params_tag (exactly the clone_for_device
+        # contract), so tier-1/2 cache entries hit across provenance.
+        engine.params_tag = fp["params_tag"]
+        engine.replica_id = replica_id
+        mm: List[Tuple[str, Any, Any]] = []
+        if engine._mesh_shape_str() != fp.get("mesh_shape"):
+            mm.append((
+                "fingerprint.mesh_shape",
+                fp.get("mesh_shape"),
+                engine._mesh_shape_str(),
+            ))
+        if cfg.name != fp.get("preset"):
+            mm.append(("fingerprint.preset", fp.get("preset"), cfg.name))
+        decoder = engine.slot_decoder()
+        # Drift refusal: the variant set is RE-DERIVED from the live
+        # ladder code and must equal the manifest's — a ladder change
+        # since build is a named refusal, never a silent retrace.
+        live = set(decoder.aot_variant_keys())
+        built = set(man["variants"])
+        if live != built:
+            mm.append((
+                "variants",
+                sorted(built - live),
+                sorted(live - built),
+            ))
+        live_enc = {f"encode:B{b}" for b in decoder.aot_encode_buckets()}
+        built_enc = set(man["encode_variants"])
+        if live_enc != built_enc:
+            mm.append((
+                "encode_variants",
+                sorted(built_enc - live_enc),
+                sorted(live_enc - built_enc),
+            ))
+        if mm:
+            raise ArtifactMismatchError(mm)
+        try:
+            with open(os.path.join(vdir, _EXEC_NAME), "rb") as f:
+                payloads = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError) as e:
+            raise ArtifactError(
+                f"unreadable executables in {vdir!r}: {e}"
+            ) from e
+        tick_exec: Dict[str, Any] = {}
+        enc_exec: Dict[str, Any] = {}
+        for key, (payload, in_tree, out_tree) in payloads.items():
+            fn = se.deserialize_and_load(payload, in_tree, out_tree)
+            (enc_exec if key.startswith("encode:") else tick_exec)[key] = fn
+        decoder.aot_install(tick_exec)
+        engine.aot_install_encode(enc_exec)
+    engine.artifact_version = man["artifact_version"]
+    prune_artifacts(
+        os.path.dirname(os.path.abspath(vdir)),
+        keep=int(getattr(cfg.serving, "artifact_keep", 2)),
+        active=vdir,
+    )
+    _log.info(
+        "artifact boot %s: %d tick + %d encode executables installed, "
+        "0 fresh compiles",
+        man["artifact_version"], len(tick_exec), len(enc_exec),
+    )
+    return engine
+
+
+# --------------------------------------------------------------- hygiene
+
+def prune_artifacts(
+    root: str, keep: int = 2, active: Optional[str] = None
+) -> List[str]:
+    """Directory hygiene: drop artifact versions beyond the ``keep``
+    newest (by mtime) plus any ``.tmp-*`` leftovers from crashed
+    builds.  The ACTIVE version (the one just loaded) is never
+    collected, regardless of age or ``keep``.  Returns the removed
+    paths."""
+    keep = max(1, int(keep))
+    if not os.path.isdir(root):
+        return []
+    active_real = os.path.realpath(active) if active else None
+    removed: List[str] = []
+    versions: List[str] = []
+    for d in sorted(os.listdir(root)):
+        p = os.path.join(root, d)
+        if not os.path.isdir(p):
+            continue
+        if d.startswith(_TMP_PREFIX):
+            shutil.rmtree(p, ignore_errors=True)
+            removed.append(p)
+        elif os.path.exists(os.path.join(p, MANIFEST_NAME)):
+            versions.append(p)
+    versions.sort(key=os.path.getmtime, reverse=True)
+    for p in versions[keep:]:
+        if active_real is not None and os.path.realpath(p) == active_real:
+            continue
+        shutil.rmtree(p, ignore_errors=True)
+        removed.append(p)
+        _log.info("pruned stale artifact version %s", p)
+    return removed

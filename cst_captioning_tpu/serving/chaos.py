@@ -395,6 +395,7 @@ def run_soak(
     trace: Sequence[RecordedRequest],
     *,
     chaos: Optional[ChaosEngine] = None,
+    autoscaler: Any = None,
     stall_tick_s: float = 0.01,
     max_ticks: int = 20_000,
 ) -> SoakReport:
@@ -404,7 +405,13 @@ def run_soak(
     (trace, chaos seed), which is the determinism contract the replay
     test pins.
 
-    Per tick: (1) chaos ``replica_kill`` / ``tick_stall`` decisions per
+    Per tick: (0) one autoscaler control-loop step when an
+    ``autoscaler`` (serving/autoscaler.py) is passed — scale-ups add
+    replicas through the real ``add_replica`` router admission,
+    scale-downs run the real kill/drain/requeue path inline (the
+    single-threaded twin of the worker's death path), so autoscaling
+    decisions are replay-deterministic exactly like the chaos schedule;
+    (1) chaos ``replica_kill`` / ``tick_stall`` decisions per
     healthy replica, (2) due arrivals submitted through the real
     admission path (``submit_async`` — priorities, shedding, Retry-After
     and deadline bookkeeping all live), (3) one scheduler iteration per
@@ -503,8 +510,14 @@ def run_soak(
     for tick in range(max_ticks):
         clock["t"] = tick
         report.ticks = tick + 1
-        # (1) chaos at the tick boundary
+        # (0) autoscaler control-loop step (scale-downs drain inline —
+        # there are no worker threads in virtual time)
+        if autoscaler is not None:
+            autoscaler.step(rs, drain_inline=True)
+        # (1) chaos at the tick boundary (replicas the autoscaler just
+        # added get a stall counter on first sight)
         for rep in rs.replicas:
+            stalled.setdefault(rep.rid, 0)
             if not rep.healthy:
                 continue
             if chaos is not None:

@@ -199,8 +199,27 @@ class InferenceEngine:
         # the default single-engine placement.
         self.device = None
         self.replica_id: Optional[int] = None
+        # Boot provenance (PR 13): "warm" for an engine that compiled
+        # its own ladder, the artifact version string for one booted via
+        # from_artifact (zero fresh tick compiles).  Surfaced in
+        # fingerprint() so a mixed-provenance fleet is diagnosable from
+        # /healthz and /debug/flight.
+        self.artifact_version: str = "warm"
         if sv.warmup:
             self.warmup()
+
+    @classmethod
+    def from_artifact(cls, path: str, replica_id: Optional[int] = None):
+        """Boot a replica from an AOT serving artifact
+        (serving/artifact.py): manifest validated field-by-field against
+        the live environment (refusal on any mismatch), params restored
+        from the artifact's orbax item, and every tick-ladder variant
+        installed as a pre-compiled executable — the returned engine's
+        slot decoder has ``compile_count == 0`` and serves token-exact
+        vs a warm-compiled engine (pinned in tests/test_artifact.py)."""
+        from cst_captioning_tpu.serving.artifact import load_engine
+
+        return load_engine(path, engine_cls=cls, replica_id=replica_id)
 
     # ------------------------------------------------------------ plumbing
     def _resolve_vocab(self, vocab: Optional[Vocabulary]) -> Vocabulary:
@@ -390,6 +409,51 @@ class InferenceEngine:
 
             self._encode_fns[B] = encode
         return self._encode_fns[B]
+
+    # ----------------------------------------------- AOT encode ladder
+    def encode_avals(self, B: int):
+        """Shape/dtype templates of one admission-encode call at batch
+        ``B`` — exactly what ``encode_prepared_rows`` assembles (float32
+        feature/mask stacks, int32 categories), so an AOT-compiled
+        encode executable accepts the live batches bit-for-bit."""
+        d = self.cfg.data
+        sds = jax.ShapeDtypeStruct
+        feats = {
+            m: sds((B, d.max_frames, d.feature_dims[m]), jnp.float32)
+            for m in d.feature_modalities
+        }
+        masks = {
+            m: sds((B, d.max_frames), jnp.float32)
+            for m in d.feature_modalities
+        }
+        cat = sds((B,), jnp.int32) if self.model.use_category else None
+        return feats, masks, cat
+
+    def aot_lower_encode(self, buckets: Sequence[int]):
+        """Builder half of the encode ladder: lower the jitted admission
+        encode at every bucket.  ``[(key, lowered), ...]`` — the
+        artifact builder compiles and serializes them; the loader
+        installs via :meth:`aot_install_encode`."""
+        p_avals = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.params
+        )
+        out = []
+        for B in buckets:
+            feats, masks, cat = self.encode_avals(B)
+            out.append((
+                f"encode:B{B}",
+                self._encode_fn(B).lower(p_avals, feats, masks, cat),
+            ))
+        return out
+
+    def aot_install_encode(self, executables: Dict[str, Any]) -> None:
+        """Loader half: install pre-compiled admission-encode
+        executables under their batch buckets — no fresh trace, no
+        fresh compile on the admission path."""
+        for key, fn in executables.items():
+            if not key.startswith("encode:B"):
+                raise ValueError(f"unknown AOT encode key {key!r}")
+            self._encode_fns[int(key[len("encode:B"):])] = fn
 
     def _state_fn(self, B: int):
         if B not in self._state_fns:
@@ -720,6 +784,10 @@ class InferenceEngine:
         )
         eng.cfg.serving.warmup = warm
         eng.params_tag = self.params_tag
+        # Weights provenance rides along (the clone's LADDER is
+        # warm-compiled, but its params came from wherever this
+        # engine's did — the fleet-diagnosis question).
+        eng.artifact_version = self.artifact_version
         eng.device = device
         eng.replica_id = replica_id
         if warm:
@@ -758,6 +826,9 @@ class InferenceEngine:
             "mesh_shape": self._mesh_shape_str(),
             "preset": self.cfg.name,
             "version": __version__,
+            # "warm" = self-compiled ladder; otherwise the AOT artifact
+            # version this engine (or its clone source) booted from.
+            "artifact_version": self.artifact_version,
         }
 
     def describe(self) -> Dict[str, Any]:

@@ -82,6 +82,10 @@ METRIC_FAMILIES = [
     ("caption_requeues_total", "counter"),
     ("caption_requeue_overflow_total", "counter"),
     ("caption_chaos_faults_total", "counter"),
+    ("caption_autoscale_decisions_total", "counter"),
+    ("caption_autoscale_scale_ups_total", "counter"),
+    ("caption_autoscale_scale_downs_total", "counter"),
+    ("caption_autoscale_target_replicas", "gauge"),
     ("caption_latency_*_ms", "histogram"),
     ("caption_steps_per_caption", "histogram"),
     ("caption_cache_*", "gauge"),
@@ -144,6 +148,16 @@ METRIC_HELP = {
     "caption_chaos_faults_total":
         "Fault injections fired by the ChaosEngine (zero unless "
         "serving.chaos is configured).",
+    "caption_autoscale_decisions_total":
+        "Autoscaler signal-window evaluations (zero unless "
+        "serving.autoscale is configured).",
+    "caption_autoscale_scale_ups_total":
+        "Applied scale-up decisions (replica added to the router).",
+    "caption_autoscale_scale_downs_total":
+        "Applied scale-down decisions (replica drained via the "
+        "requeue path).",
+    "caption_autoscale_target_replicas":
+        "The autoscaler's current target healthy-replica count.",
     "caption_latency_*_ms":
         "Per-stage request latency in milliseconds.",
     "caption_steps_per_caption":
@@ -331,6 +345,13 @@ class ServingMetrics:
         self.requeues_total = Counter()
         self.requeue_overflow = Counter()
         self.chaos_faults = Counter()
+        # Elastic autoscaler (ISSUE 13): window evaluations, applied
+        # scale actions, and the current replica target — all zero
+        # unless serving.autoscale is configured.
+        self.autoscale_decisions = Counter()
+        self.autoscale_ups = Counter()
+        self.autoscale_downs = Counter()
+        self.autoscale_target = Gauge()
         # Decode steps each caption actually paid before its slot freed.
         self.steps_per_caption = LatencyHistogram(STEP_BUCKETS)
         # Per-replica label sets, created on first use (replica ids are
@@ -400,6 +421,12 @@ class ServingMetrics:
                 "requeue_overflow": self.requeue_overflow.value,
                 "chaos_faults": self.chaos_faults.value,
             },
+            "autoscale": {
+                "decisions": self.autoscale_decisions.value,
+                "scale_ups": self.autoscale_ups.value,
+                "scale_downs": self.autoscale_downs.value,
+                "target_replicas": self.autoscale_target.value,
+            },
             "latency_ms": {s: h.snapshot() for s, h in self.stages.items()},
         }
         reps = self._replica_items()
@@ -459,6 +486,9 @@ class ServingMetrics:
             "caption_requeues_total": self.requeues_total,
             "caption_requeue_overflow_total": self.requeue_overflow,
             "caption_chaos_faults_total": self.chaos_faults,
+            "caption_autoscale_decisions_total": self.autoscale_decisions,
+            "caption_autoscale_scale_ups_total": self.autoscale_ups,
+            "caption_autoscale_scale_downs_total": self.autoscale_downs,
         }
         for name, c in counters.items():
             self._header(lines, name, name, "counter")
@@ -476,6 +506,7 @@ class ServingMetrics:
             ("caption_slots_occupied", self.slots_occupied),
             ("caption_decode_state_bytes", self.decode_state_bytes),
             ("caption_slot_bank_size", self.slot_bank_size),
+            ("caption_autoscale_target_replicas", self.autoscale_target),
         ):
             self._header(lines, name, name, "gauge")
             lines.append(f"{name} {g.value}")

@@ -340,6 +340,45 @@ class ReplicaSet(_BatcherBase):
             self.replicas[rid].healthy = False
             self._cond.notify_all()
 
+    def add_replica(self, engine) -> int:
+        """Admit a freshly-booted engine (artifact boot or clone) to the
+        router as a new replica — the autoscaler's scale-up primitive.
+        Replica ids only ever grow (dead replicas keep their slot in
+        ``self.replicas``), so metrics labels and flight-ring names stay
+        stable across the fleet's whole life.  If the scheduler is
+        running, the new replica's worker thread starts immediately;
+        otherwise it starts with the next :meth:`start` (or is stepped
+        by the virtual-time soak harness)."""
+        with self._cond:
+            rid = len(self.replicas)
+            try:
+                engine.replica_id = rid
+            except AttributeError:  # engine doubles without the field
+                pass
+            rep = Replica(rid, engine)
+            self.replicas.append(rep)
+            rm = self.metrics.replica(rid)
+            rm.healthy.set(1)
+            rm.slots_occupied.set(0)
+            rm.queue_depth.set(0)
+            self.metrics.slots_total.set(sum(
+                r.decoder.S for r in self.replicas if r.healthy
+            ))
+            running = bool(self._threads)
+            self._cond.notify_all()
+        if running:
+            t = threading.Thread(
+                target=self._worker,
+                args=(rep,),
+                name=f"caption-replica-{rid}",
+                daemon=True,
+            )
+            rep.thread = t
+            with self._cond:
+                self._threads.append(t)
+            t.start()
+        return rid
+
     # ------------------------------------------------------------- routing
     def _depth_locked(self) -> int:
         return sum(len(r.q) for r in self.replicas)
@@ -802,4 +841,10 @@ class ReplicaSet(_BatcherBase):
                 for r in self.replicas
             ],
             "slots_per_replica": [r.decoder.S for r in self.replicas],
+            # Mixed-provenance diagnosis (ISSUE 13): which replicas
+            # booted from an AOT artifact ("v…") vs warm-compiled.
+            "artifact_versions": [
+                str(getattr(r.engine, "artifact_version", "warm"))
+                for r in self.replicas
+            ],
         }

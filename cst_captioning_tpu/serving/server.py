@@ -363,6 +363,31 @@ class CaptionServer:
             else:
                 batcher = MicroBatcher(engine, self.metrics)
         self.batcher = batcher
+        # Elastic autoscaler (serving/autoscaler.py): constructed only
+        # when `serving.autoscale` is configured AND the scheduler is a
+        # ReplicaSet (the single-replica schedulers have no fleet to
+        # size).  Default scale-up factory: clone the loaded engine
+        # round-robin over local devices; artifact fleets boot new
+        # replicas via cli/serve.py --artifact + from_artifact instead.
+        from cst_captioning_tpu.serving.autoscaler import (
+            AutoscaleConfig,
+            Autoscaler,
+        )
+
+        self.autoscaler = None
+        as_cfg = AutoscaleConfig.from_config(sv)
+        if as_cfg is not None and hasattr(self.batcher, "add_replica"):
+            import jax
+
+            devs = jax.devices()
+
+            def _scale_up_engine():
+                rid = len(self.batcher.replicas)
+                return engine.clone_for_device(
+                    devs[rid % len(devs)], replica_id=rid
+                )
+
+            self.autoscaler = Autoscaler(as_cfg, _scale_up_engine)
         self._http = _Server(
             (host if host is not None else sv.host,
              port if port is not None else sv.port),
@@ -391,6 +416,8 @@ class CaptionServer:
 
     def start(self) -> "CaptionServer":
         self.batcher.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start(self.batcher)
         self._thread = threading.Thread(
             target=self._http.serve_forever,
             name="caption-http",
@@ -405,6 +432,8 @@ class CaptionServer:
 
     def serve_forever(self) -> None:
         self.batcher.start()
+        if self.autoscaler is not None:
+            self.autoscaler.start(self.batcher)
         _log.info(
             "caption server listening on %s (%s scheduler)",
             self.url, type(self.batcher).__name__,
@@ -453,6 +482,10 @@ class CaptionServer:
                 return
             self._closed = True
             self.begin_drain()
+            # Stop the control loop BEFORE the drain: a scale decision
+            # landing mid-teardown would race the worker joins.
+            if self.autoscaler is not None:
+                self.autoscaler.stop()
             self.batcher.stop(drain=drain)
             self._http.shutdown()
             self._http.server_close()

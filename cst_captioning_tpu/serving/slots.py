@@ -285,28 +285,35 @@ class SlotDecoder:
         deduped, one per beam row in the legacy replicated layout."""
         return S if self.dedup else S * self.K
 
-    def _init_state(self, S: int) -> SlotState:
-        model, K, L = self.model, self.K, self.L
-        cdt = jnp.dtype(model.compute_dtype)
-        n = S * K
-        nc = self._cache_rows(S)
+    def _cache_avals(self, rows: int) -> DecodeCache:
+        """Shape/dtype structs of a ``rows``-row projected DecodeCache —
+        exactly one encode output's leaves with a ``rows`` leading dim.
+        The ONE shape source for slot-state init AND the AOT artifact
+        lowering (serving/artifact.py), so the two can never drift."""
+        model = self.model
         d = self.engine.cfg.data
-        # Zero cache rows shaped exactly like one encode output: let
-        # eval_shape infer the (nc, ...) DecodeCache leaf shapes.
         feats = {
-            m: jnp.zeros((nc, d.max_frames, d.feature_dims[m]))
+            m: jnp.zeros((rows, d.max_frames, d.feature_dims[m]))
             for m in d.feature_modalities
         }
-        masks = {m: jnp.ones((nc, d.max_frames)) for m in feats}
+        masks = {m: jnp.ones((rows, d.max_frames)) for m in feats}
         cat = (
-            jnp.zeros((nc,), jnp.int32) if model.use_category else None
+            jnp.zeros((rows,), jnp.int32) if model.use_category else None
         )
-        cache_shape = jax.eval_shape(
+        return jax.eval_shape(
             lambda f, mk, c: model.apply(
                 self.engine.params, f, mk, c, method="init_decode"
             )[1],
             feats, masks, cat,
         )
+
+    def _init_state(self, S: int) -> SlotState:
+        model, K, L = self.model, self.K, self.L
+        cdt = jnp.dtype(model.compute_dtype)
+        n = S * K
+        # Zero cache rows shaped exactly like one encode output: let
+        # eval_shape infer the DecodeCache leaf shapes.
+        cache_shape = self._cache_avals(self._cache_rows(S))
         cache = jax.tree.map(
             lambda sds: jnp.zeros(sds.shape, sds.dtype), cache_shape
         )
@@ -394,13 +401,16 @@ class SlotDecoder:
             jnp.arange(K) == 0, 0.0, NEG_INF
         ).astype(jnp.float32)[None, :]                          # (1, K)
 
-    def _tick_fn(self, A: int):
-        """One compiled scheduler iteration at the CURRENT bank size:
-        scatter A admissions into their slots (A static per variant,
-        0 = pure step), then run the step block.  Returns the new state
-        plus everything the host needs — done flags and the token/score
-        matrices — so harvests cost no extra device call."""
-        key = (self.S, A)
+    def _tick_fn(self, A: int, S: Optional[int] = None):
+        """One compiled scheduler iteration at bank size ``S`` (default:
+        the CURRENT bank): scatter A admissions into their slots (A
+        static per variant, 0 = pure step), then run the step block.
+        Returns the new state plus everything the host needs — done
+        flags and the token/score matrices — so harvests cost no extra
+        device call.  ``S`` only keys the variant cache (the traced fn
+        takes its shapes from its arguments); the AOT artifact builder
+        passes it explicitly to lower every bank's variant."""
+        key = ((self.S if S is None else S), A)
         if key in self._tick_fns:
             return self._tick_fns[key]
         self.compile_count += 1
@@ -942,10 +952,9 @@ class SlotDecoder:
         for bank in self.bank_ladder:
             if bank != self.S:
                 self._set_bank(bank)          # compiles the grow fns
-            warm_As = sorted({
-                self._pad_bucket(min(b, bank))
-                for b in self._admit_buckets
-            })
+            warm_As = [
+                a for a in self.warm_admit_counts(bank) if a > 0
+            ]
             for A in warm_As:
                 n = min(A, bank)
                 done = self.tick([req] * n, [None] * n)
@@ -969,6 +978,129 @@ class SlotDecoder:
         self.resize_count = 0
         self.last_resize_ms = self.worst_resize_ms = 0.0
         assert not self.occupied and len(self.free) == self.S
+
+    # ----------------------------------------------- AOT artifact ladder
+    # The artifact subsystem (serving/artifact.py) precompiles EVERY
+    # variant warmup() builds — enumerated HERE, from the same
+    # bank-ladder/admit-bucket code warmup() walks, so the artifact and
+    # the live ladder can never drift (the loader refuses on a key-set
+    # mismatch, and tier-1 pins warmup's built keys == aot_variant_keys).
+
+    def warm_admit_counts(self, bank: int) -> List[int]:
+        """Admission-count variants reachable at ``bank`` (including the
+        pure-step A=0 tick): every A ``tick_begin`` can dispatch is the
+        pad bucket of some n <= min(bank, admit_cap), and each such
+        bucket equals ``_pad_bucket(min(b, bank))`` for a ladder bucket
+        b — the exact set warmup() compiles."""
+        return sorted({
+            self._pad_bucket(min(b, bank)) for b in self._admit_buckets
+        } | {0})
+
+    def aot_variant_keys(self) -> List[str]:
+        """Stable string keys of every compiled variant the loop can
+        hit post-warmup: tick fns per (bank, admit bucket), the
+        freed-slot blanking fn per bank, and both directions of every
+        adjacent bank transition."""
+        keys: List[str] = []
+        for bank in self.bank_ladder:
+            for A in self.warm_admit_counts(bank):
+                keys.append(f"tick:S{bank}:A{A}")
+            if self.zero_freed:
+                keys.append(f"free:S{bank}")
+        for a, b in zip(self.bank_ladder, self.bank_ladder[1:]):
+            keys.append(f"resize:{a}->{b}")
+            keys.append(f"resize:{b}->{a}")
+        return keys
+
+    def _state_avals(self, S: int) -> SlotState:
+        """Shape/dtype structs of the slot-state pytree at bank ``S``
+        (the lowering templates for that bank's variants)."""
+        st = self._st if S == self.S else self._init_state(S)
+        return jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, jnp.dtype(x.dtype)),
+            st,
+        )
+
+    def aot_lower(self):
+        """Builder half of the AOT artifact contract: lower every
+        :meth:`aot_variant_keys` variant against its exact runtime
+        shapes.  Returns ``[(key, lowered), ...]`` in key order — the
+        caller compiles each (``serving/artifact.py``, through the
+        persistent compilation cache) and serializes the executables.
+        Builds the underlying jitted fns, so this counts toward
+        ``compile_count`` like warmup does; the LOADER side
+        (:meth:`aot_install`) builds nothing."""
+        sds = jax.ShapeDtypeStruct
+        p_avals = jax.tree.map(
+            lambda x: sds(x.shape, x.dtype), self.engine.params
+        )
+        out = []
+        for bank in self.bank_ladder:
+            st_avals = self._state_avals(bank)
+            for A in self.warm_admit_counts(bank):
+                fn = self._tick_fn(A, S=bank)
+                if A:
+                    # The encode emits A rows regardless of layout; the
+                    # legacy replicated tick fans out to K inside.
+                    rows = self._cache_avals(A)
+                    slots = sds((A,), jnp.int32)
+                    low = fn.lower(p_avals, st_avals, slots, rows)
+                else:
+                    low = fn.lower(p_avals, st_avals, None, None)
+                out.append((f"tick:S{bank}:A{A}", low))
+            if self.zero_freed:
+                mask = sds((bank,), jnp.bool_)
+                out.append((
+                    f"free:S{bank}",
+                    self._free_fn(bank).lower(st_avals, mask),
+                ))
+        for a, b in zip(self.bank_ladder, self.bank_ladder[1:]):
+            out.append((
+                f"resize:{a}->{b}",
+                self._resize_fn(a, b).lower(self._state_avals(a)),
+            ))
+            out.append((
+                f"resize:{b}->{a}",
+                self._resize_fn(b, a).lower(self._state_avals(b)),
+            ))
+        return out
+
+    def aot_encode_buckets(self) -> List[int]:
+        """Every admission-encode batch shape
+        ``InferenceEngine.encode_prepared_rows`` can dispatch: the admit
+        buckets (full-miss batches encode at the tick's padded bucket)
+        plus the power-of-two mixed-miss buckets up to the next power of
+        two >= ``admit_cap`` — the artifact builder precompiles the
+        encode at each."""
+        p = 1
+        while p < self.admit_cap:
+            p *= 2
+        pow2, b = [], 1
+        while b <= p:
+            pow2.append(b)
+            b *= 2
+        return sorted(set(self._admit_buckets) | set(pow2))
+
+    def aot_install(self, executables: Dict[str, Any]) -> None:
+        """Loader half: place ready-to-call compiled executables (keyed
+        by :meth:`aot_variant_keys` strings) into the variant caches
+        WITHOUT building anything — post-install traffic is hit-only and
+        ``compile_count`` stays exactly where it was (0 on an
+        artifact-booted decoder, the tier-1 pin).  Unknown keys raise:
+        the artifact loader checks set equality first, so a reject here
+        means ladder drift."""
+        for key, fn in executables.items():
+            kind, _, rest = key.partition(":")
+            if kind == "tick":
+                s_part, _, a_part = rest.partition(":")
+                self._tick_fns[(int(s_part[1:]), int(a_part[1:]))] = fn
+            elif kind == "free":
+                self._free_fns[int(rest[1:])] = fn
+            elif kind == "resize":
+                a, _, b = rest.partition("->")
+                self._resize_fns[(int(a), int(b))] = fn
+            else:
+                raise ValueError(f"unknown AOT variant key {key!r}")
 
     def describe(self) -> Dict[str, Any]:
         return {
@@ -1068,19 +1200,32 @@ class _ParityEngine:
 
 
 def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0,
-                 model_shards: int = 1):
+                 model_shards: int = 1, aot: bool = False):
     """Decode every ctx row through a small slot matrix with staggered
     admissions (slots hold rows at different decode depths), then map
     harvests back to row order.  ``dedup`` selects the per-slot vs the
     legacy replicated cache layout; ``bank_min`` > 0 exercises the
     elastic bank ladder mid-traffic; ``model_shards`` > 1 runs the
-    model-sharded (data=1, model=N) engine layout."""
+    model-sharded (data=1, model=N) engine layout; ``aot`` runs the
+    artifact boot path — every variant ``.lower().compile()``d by a
+    builder decoder and installed into a FRESH decoder that must build
+    zero variants of its own (``compile_count == 0``, the PR-13 pin)."""
     B = next(iter(ctx.feats.values())).shape[0]
     eng = _ParityEngine(
         ctx, mode=mode, num_slots=max(2, B // 2), block=1,
         dedup=dedup, bank_min=bank_min, model_shards=model_shards,
     )
     dec = SlotDecoder(eng)
+    if aot:
+        # Builder decoder lowers+compiles the ladder; the serving
+        # decoder only installs executables — zero fresh traces.
+        builder = SlotDecoder(eng)
+        compiled = {
+            key: low.compile() for key, low in builder.aot_lower()
+        }
+        assert set(compiled) == set(dec.aot_variant_keys())
+        dec.aot_install(compiled)
+        assert dec.compile_count == 0
     got_tok: Dict[int, np.ndarray] = {}
     got_score: Dict[int, float] = {}
     pending = list(range(B))
@@ -1095,6 +1240,11 @@ def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0,
         for i, tokens, score, steps in dec.harvest_many(done):
             got_tok[i], got_score[i] = tokens, score
             assert 0 < steps <= dec.L
+    if aot:
+        assert dec.compile_count == 0, (
+            "artifact-booted decoder built a fresh tick variant under "
+            "traffic — the AOT ladder drifted from warmup's"
+        )
     return {
         "tokens": np.stack([got_tok[i] for i in range(B)]),
         "scores": (
@@ -1130,6 +1280,19 @@ register_backend(
 register_backend(
     "slot_decoder_beam_elastic",
     lambda ctx: _slot_runner(ctx, "beam", bank_min=2),
+    kind="beam",
+    ref="scan_beam",
+)
+# AOT artifact-boot variant (PR 13): every tick/free/resize variant is
+# `.lower().compile()`d ahead of time by a builder decoder and installed
+# into a fresh decoder that never builds (or traces) a variant itself —
+# compile_count stays 0 and tokens must match the scan reference
+# exactly, which is the docs/PARITY.md argument for why an
+# artifact-booted replica cannot change any caption: the executables ARE
+# the warmup-compiled programs, only their compilation moved in time.
+register_backend(
+    "slot_decoder_beam_aot",
+    lambda ctx: _slot_runner(ctx, "beam", aot=True),
     kind="beam",
     ref="scan_beam",
 )

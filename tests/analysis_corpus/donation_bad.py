@@ -1,7 +1,8 @@
 # corpus-rules: donation
 """Seeded donation/compile-discipline violations: an update step whose
-registry entry demands donation but whose jit call forgot it, and a
-jit site with no registry entry at all.  (The corpus test injects the
+registry entry demands donation but whose jit call forgot it, a jit
+site with no registry entry at all, and an AOT ``.lower().compile()``
+site missing from the AOT registry.  (The corpus test injects the
 matching registry entry for the first key.)"""
 
 import jax
@@ -20,3 +21,9 @@ def make_unregistered(model):
         return x
 
     return jax.jit(mystery)  # expect: CST-DON-002
+
+
+def make_unregistered_aot(jitted, avals):
+    # ahead-of-time compile outside the jit dispatch path, with no
+    # AOT_SITE_REGISTRY entry naming its variant/refusal story
+    return jitted.lower(avals).compile()  # expect: CST-DON-004

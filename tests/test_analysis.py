@@ -510,6 +510,40 @@ class TestRegistryFaults:
             for f in findings
         )
 
+    def test_unregistering_an_aot_site_fires_don004(self, monkeypatch):
+        """The PR-13 AOT coverage: dropping the artifact builder's
+        AOT_SITE_REGISTRY entry makes its `.lower().compile()` loop an
+        unregistered AOT site."""
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        key = "serving/artifact.py::build_artifact"
+        reg = dict(jr.AOT_SITE_REGISTRY)
+        assert key in reg
+        reg.pop(key)
+        monkeypatch.setattr(jr, "AOT_SITE_REGISTRY", reg)
+        mods, ctx = self._ctx_mods()
+        findings = CHECKERS["donation"](mods, ctx)
+        assert any(
+            f.rule == "CST-DON-004" and key in f.message
+            for f in findings
+        )
+
+    def test_stale_aot_entry_fires_don005(self, monkeypatch):
+        """The AOT registry cannot rot: an entry matching no live
+        lower/compile or executable-load site is a finding."""
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        reg = dict(jr.AOT_SITE_REGISTRY)
+        reg["serving/artifact.py::retired_builder"] = "moved away"
+        monkeypatch.setattr(jr, "AOT_SITE_REGISTRY", reg)
+        mods, ctx = self._ctx_mods()
+        findings = CHECKERS["donation"](mods, ctx)
+        assert any(
+            f.rule == "CST-DON-005"
+            and "retired_builder" in f.message
+            for f in findings
+        )
+
     def test_undonated_update_step_fires_don001(self, monkeypatch):
         """Flip the XE train step's registry entry onto a site that
         does NOT donate (the validation sampler) — DON-001 must fire."""

@@ -1,0 +1,257 @@
+"""AOT serving artifacts (ISSUE 13, serving/artifact.py).
+
+Covers the tentpole acceptance bars:
+
+* build/publish is ATOMIC (tmp + rename, no .tmp leftovers) and
+  idempotent (content-hash version: rebuilding an unchanged engine
+  reuses the published dir);
+* the artifact enumerates EXACTLY the variants warmup() compiles —
+  after ``aot_lower`` a full ``warmup()`` builds ZERO new variants (the
+  no-drift pin), and the manifest key set equals
+  ``aot_variant_keys()``;
+* ``InferenceEngine.from_artifact`` boots with ``compile_count == 0``
+  (zero fresh tick-ladder compiles) and serves TOKEN-EXACT vs a
+  warm-compiled engine over the same params/requests — compile_count
+  still 0 after traffic (drift would lazily build);
+* the refusal contract: any manifest field diverging from the live
+  environment raises ``ArtifactMismatchError`` naming every divergent
+  field (toolchain fields AND ladder-drift key sets);
+* directory hygiene: the loader GCs versions beyond
+  ``serving.artifact_keep``; the ACTIVE version is never collected,
+  ``.tmp-*`` crash leftovers are swept.
+
+The shared-harness twin (``slot_decoder_beam_aot`` in
+tests/test_decode_core.py) pins the install path token-exact against
+the scan reference across the whole backend matrix.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.config import get_preset
+from cst_captioning_tpu.data.vocab import Vocabulary, decode_sequence
+from cst_captioning_tpu.serving.artifact import (
+    MANIFEST_NAME,
+    ArtifactError,
+    ArtifactMismatchError,
+    build_artifact,
+    load_manifest,
+    prune_artifacts,
+)
+from cst_captioning_tpu.serving.engine import InferenceEngine
+
+
+def _tiny_cfg():
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.num_slots = 4
+    cfg.serving.slot_bank_min = 2
+    cfg.serving.max_batch_size = 4
+    cfg.serving.batch_shapes = [2, 4]
+    return cfg
+
+
+@pytest.fixture(scope="module")
+def art_world(tmp_path_factory):
+    """One built artifact over one random-init engine (build is the
+    expensive step — shared module-wide)."""
+    cfg = _tiny_cfg()
+    vocab = Vocabulary([f"w{i}" for i in range(60)])
+    cfg.model.vocab_size = len(vocab)
+    engine = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    root = str(tmp_path_factory.mktemp("artifacts"))
+    summary = build_artifact(engine, root)
+    return engine, vocab, root, summary
+
+
+def _payloads(cfg, n, seed=0):
+    rng = np.random.RandomState(seed)
+    d = cfg.data
+    return [
+        {
+            "features": {
+                m: rng.randn(d.max_frames, d.feature_dims[m]).astype(
+                    np.float32
+                )
+                for m in d.feature_modalities
+            }
+        }
+        for _ in range(n)
+    ]
+
+
+def _decode_all(engine, decoder, payloads):
+    """Staggered slot decode of every payload; tokens in payload order."""
+    reqs = [engine.prepare(dict(p)) for p in payloads]
+    pending = list(enumerate(reqs))
+    got = {}
+    while pending or decoder.occupied:
+        n = min(1, len(pending), len(decoder.free))
+        batch = [pending.pop(0) for _ in range(n)]
+        done = decoder.tick([r for _, r in batch], [i for i, _ in batch])
+        for i, tokens, _score, _steps in decoder.harvest_many(done):
+            got[i] = tokens
+    return [got[i] for i in range(len(payloads))]
+
+
+class TestArtifactBuild:
+    def test_publish_is_atomic_and_versioned(self, art_world):
+        _, _, root, summary = art_world
+        assert summary["rebuilt"] is True
+        vdir = summary["path"]
+        assert os.path.exists(os.path.join(vdir, MANIFEST_NAME))
+        assert summary["artifact_version"].startswith("v")
+        # no half-written build sibling survives a successful publish
+        assert not [
+            d for d in os.listdir(root) if d.startswith(".tmp-")
+        ]
+        man = load_manifest(vdir)
+        assert man["artifact_version"] == summary["artifact_version"]
+        for key in ("params_tag", "mesh_shape", "preset", "version"):
+            assert key in man["fingerprint"], key
+        for key in ("jax_version", "jaxlib_version", "platform",
+                    "device_kind"):
+            assert key in man["env"], key
+
+    def test_rebuild_of_unchanged_engine_reuses_version(self, art_world):
+        engine, _, root, summary = art_world
+        again = build_artifact(engine, root)
+        assert again["rebuilt"] is False
+        assert again["artifact_version"] == summary["artifact_version"]
+        assert again["path"] == summary["path"]
+
+    def test_warmup_builds_nothing_beyond_the_aot_ladder(self, art_world):
+        """THE no-drift pin: after ``aot_lower`` enumerated/built every
+        variant (inside build_artifact), a FULL warmup() compiles zero
+        new ones — the artifact covers exactly warmup's ladder."""
+        engine, _, _, summary = art_world
+        dec = engine.slot_decoder()
+        n0 = dec.compile_count
+        dec.warmup()
+        assert dec.compile_count == n0, (
+            "warmup built a variant aot_lower did not enumerate"
+        )
+        # and the manifest's key set is the live enumeration, verbatim
+        man = load_manifest(summary["path"])
+        assert set(man["variants"]) == set(dec.aot_variant_keys())
+        assert set(man["encode_variants"]) == {
+            f"encode:B{b}" for b in dec.aot_encode_buckets()
+        }
+
+
+class TestArtifactBoot:
+    def test_zero_compiles_and_token_exact_vs_warm(self, art_world):
+        engine, _, _, summary = art_world
+        booted = InferenceEngine.from_artifact(summary["path"])
+        dec = booted.slot_decoder()
+        assert dec.compile_count == 0, (
+            "artifact boot traced/compiled a tick variant"
+        )
+        assert booted.artifact_version == summary["artifact_version"]
+        assert (
+            booted.fingerprint()["artifact_version"]
+            == summary["artifact_version"]
+        )
+        assert engine.fingerprint()["artifact_version"] == "warm"
+        # Same logical model: the artifact boot inherits the build-time
+        # params_tag (cache keys hit across provenance).
+        assert booted.params_tag == engine.params_tag
+        payloads = _payloads(engine.cfg, 5)
+        warm_dec = engine.slot_decoder()   # warmed by the drift test
+        warm = _decode_all(engine, warm_dec, payloads)
+        aot = _decode_all(booted, dec, payloads)
+        for i, (a, b) in enumerate(zip(warm, aot)):
+            assert np.array_equal(a, b), (
+                f"payload {i}: artifact boot changed tokens\n"
+                f"warm: {decode_sequence(engine.vocab, a[None])[0]}\n"
+                f"aot:  {decode_sequence(booted.vocab, b[None])[0]}"
+            )
+        # Traffic (including elastic resizes in _decode_all's ticks)
+        # stayed hit-only: drift would have lazily built a variant.
+        assert dec.compile_count == 0
+
+    def test_refusal_names_every_divergent_field(
+        self, art_world, tmp_path
+    ):
+        _, _, _, summary = art_world
+        vdir = os.path.join(str(tmp_path), "copy")
+        shutil.copytree(summary["path"], vdir)
+        mpath = os.path.join(vdir, MANIFEST_NAME)
+        with open(mpath) as f:
+            man = json.load(f)
+        man["env"]["jax_version"] = "9.9.9"
+        man["fingerprint"]["version"] = "0.0.0-other"
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        with pytest.raises(ArtifactMismatchError) as ei:
+            InferenceEngine.from_artifact(vdir)
+        fields = {f for f, _, _ in ei.value.mismatches}
+        assert fields == {"env.jax_version", "fingerprint.version"}
+        assert "9.9.9" in str(ei.value)
+
+    def test_refusal_on_ladder_drift(self, art_world, tmp_path):
+        """A variant-set mismatch (the ladder code moved since build)
+        is a named refusal, never a silent retrace."""
+        _, _, _, summary = art_world
+        vdir = os.path.join(str(tmp_path), "drift")
+        shutil.copytree(summary["path"], vdir)
+        mpath = os.path.join(vdir, MANIFEST_NAME)
+        with open(mpath) as f:
+            man = json.load(f)
+        man["variants"]["tick:S64:A64"] = man["variants"].pop(
+            sorted(man["variants"])[0]
+        )
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        with pytest.raises(ArtifactMismatchError) as ei:
+            InferenceEngine.from_artifact(vdir)
+        assert any(f == "variants" for f, _, _ in ei.value.mismatches)
+
+    def test_malformed_artifact_is_a_named_error(self, tmp_path):
+        with pytest.raises(ArtifactError, match="no published artifact"):
+            InferenceEngine.from_artifact(str(tmp_path))
+
+
+class TestArtifactHygiene:
+    def _fake_version(self, root, name, age):
+        p = os.path.join(root, name)
+        os.makedirs(p)
+        with open(os.path.join(p, MANIFEST_NAME), "w") as f:
+            f.write("{}")
+        os.utime(p, (age, age))
+        return p
+
+    def test_prune_keeps_newest_and_never_the_active(self, tmp_path):
+        root = str(tmp_path)
+        old = self._fake_version(root, "vaaa", 1_000)
+        mid = self._fake_version(root, "vbbb", 2_000)
+        new = self._fake_version(root, "vccc", 3_000)
+        tmp = os.path.join(root, ".tmp-vddd-1")
+        os.makedirs(tmp)
+        # keep=1: the newest survives, the ACTIVE (oldest!) survives
+        # regardless, everything else — including crash leftovers — goes.
+        removed = prune_artifacts(root, keep=1, active=old)
+        assert os.path.isdir(old), "the active version was collected"
+        assert os.path.isdir(new)
+        assert not os.path.isdir(mid)
+        assert not os.path.isdir(tmp)
+        assert set(removed) == {mid, tmp}
+
+    def test_load_gc_respects_artifact_keep(self, art_world):
+        """Loading an artifact sweeps stale sibling versions beyond
+        serving.artifact_keep (default 2) but keeps the loaded one."""
+        _, _, root, summary = art_world
+        stale = [
+            self._fake_version(root, f"vstale{i}", 10 + i)
+            for i in range(3)
+        ]
+        booted = InferenceEngine.from_artifact(summary["path"])
+        assert booted.artifact_version == summary["artifact_version"]
+        assert os.path.isdir(summary["path"])
+        # keep=2 with the active dir newest: at most one stale survives
+        survivors = [p for p in stale if os.path.isdir(p)]
+        assert len(survivors) <= 1

@@ -1,0 +1,293 @@
+"""Elastic replica autoscaler (ISSUE 13, serving/autoscaler.py).
+
+Covers the satellite acceptance bars on the PR-11 virtual-time
+machinery (stub engines — no jax decode; the real-engine
+artifact-vs-warm token pins live in tests/test_artifact.py and the
+``slot_decoder_beam_aot`` harness backend):
+
+* off-by-default: every preset's ``AutoscaleConfig.from_config`` is
+  None; unknown/invalid keys are named errors;
+* scale-UP under a recorded queue burst (through the real
+  ``ReplicaSet.add_replica`` router admission), scale-DOWN only after a
+  full idle window + cooldown, bounds respected throughout;
+* ZERO requests lost across a scale-down drain: the victim's in-flight
+  work requeues onto survivors (the PR-4 path) and still serves;
+* determinism: the same recorded trace + config replays to a
+  byte-identical decision log (the chaos-engine determinism contract
+  applied to scaling);
+* every applied decision lands as a registered ``autoscale`` flight
+  event and on the ``caption_autoscale_*`` metric families.
+"""
+
+import pytest
+
+from test_chaos import _StubEngine, _payloads
+
+from cst_captioning_tpu.config import PRESETS
+from cst_captioning_tpu.serving.autoscaler import (
+    AutoscaleConfig,
+    Autoscaler,
+    Decision,
+    Signals,
+)
+from cst_captioning_tpu.serving.chaos import make_diurnal_trace, run_soak
+from cst_captioning_tpu.serving.metrics import ServingMetrics
+from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+
+def _sig(queued=0, occupied=0, slots=1, healthy=1, shed=0, p99=0.0):
+    return Signals(
+        queued=queued, occupied=occupied, slots=slots,
+        healthy=healthy, shed=shed, queue_wait_p99_ms=p99,
+    )
+
+
+class TestAutoscaleConfig:
+    def test_off_by_default_in_every_preset(self):
+        for name, mk in PRESETS.items():
+            cfg = mk()
+            assert AutoscaleConfig.from_config(cfg.serving) is None, (
+                f"preset {name} silently enables autoscaling"
+            )
+
+    def test_unknown_key_is_a_named_error(self):
+        class S:
+            autoscale = {"max_replicas": 2, "scale_up_qeue_depth": 1}
+
+        with pytest.raises(ValueError, match="scale_up_qeue_depth"):
+            AutoscaleConfig.from_config(S())
+
+    def test_bounds_validated(self):
+        with pytest.raises(ValueError, match="max_replicas"):
+            AutoscaleConfig(min_replicas=3, max_replicas=2)
+        with pytest.raises(ValueError, match="min_replicas"):
+            AutoscaleConfig(min_replicas=0)
+
+
+class TestDecisionPolicy:
+    """The pure signal-window policy (observe): deterministic in the
+    signal stream, hysteretic, bounded."""
+
+    def _scaler(self, **kw):
+        cfg = AutoscaleConfig(**{
+            "min_replicas": 1, "max_replicas": 3, "window_ticks": 3,
+            "scale_up_queue_depth": 4.0, "cooldown_ticks": 4, **kw,
+        })
+        return Autoscaler(cfg, engine_factory=lambda: _StubEngine(S=1))
+
+    def test_queue_pressure_scales_up(self):
+        sc = self._scaler()
+        d = sc.observe(_sig(queued=8, healthy=1))
+        assert d == Decision("up", "queue_depth", 1, 2)
+
+    def test_shed_inside_window_scales_up(self):
+        sc = self._scaler()
+        sc.observe(_sig(shed=0))
+        d = sc.observe(_sig(shed=2))   # cumulative counter jumped
+        assert d.action == "up" and d.reason == "shed"
+
+    def test_at_max_holds_with_named_reason(self):
+        sc = self._scaler()
+        d = sc.observe(_sig(queued=50, healthy=3))
+        assert d.action == "hold" and "at_max" in d.reason
+
+    def test_scale_down_needs_a_full_quiet_window(self):
+        sc = self._scaler(scale_down_occupancy=0.5)
+        quiet = _sig(queued=0, occupied=0, slots=4, healthy=2)
+        assert sc.observe(quiet).action == "hold"    # window filling
+        assert sc.observe(quiet).action == "hold"
+        d = sc.observe(quiet)                        # window full
+        assert d == Decision("down", "idle_window", 2, 1)
+
+    def test_busy_tick_resets_the_quiet_window(self):
+        sc = self._scaler(scale_down_occupancy=0.5)
+        quiet = _sig(queued=0, occupied=0, slots=4, healthy=2)
+        sc.observe(quiet)
+        sc.observe(_sig(queued=3, occupied=4, slots=4, healthy=2))
+        d = sc.observe(quiet)
+        assert d.action == "hold", "a busy tick must not allow shrink"
+
+    def test_cooldown_holds_both_directions(self):
+        sc = self._scaler()
+        sc._cooldown = 2
+        assert sc.observe(_sig(queued=50)).reason == "cooldown"
+        assert sc.observe(_sig(queued=50)).reason == "cooldown"
+        assert sc.observe(_sig(queued=50)).action == "up"
+
+    def test_never_below_min(self):
+        sc = self._scaler(min_replicas=2)
+        quiet = _sig(queued=0, occupied=0, slots=2, healthy=2)
+        for _ in range(6):
+            assert sc.observe(quiet).action != "down"
+
+
+def _fleet(n=1, queue_depth=64):
+    engines = [_StubEngine(S=1) for _ in range(n)]
+    return ReplicaSet(engines, ServingMetrics(), queue_depth=queue_depth)
+
+
+def _soak_with_scaler(seed, *, n_reqs=30, cfg_kw=None):
+    trace = make_diurnal_trace(
+        seed, n_reqs, 10, base_per_tick=1.5, burst_factor=5.0,
+        period_ticks=24,
+    )
+    rs = _fleet(1)
+    cfg = AutoscaleConfig(**{
+        "min_replicas": 1, "max_replicas": 3, "window_ticks": 2,
+        "scale_up_queue_depth": 2.0, "cooldown_ticks": 3,
+        "scale_down_occupancy": 0.9, **(cfg_kw or {}),
+    })
+    scaler = Autoscaler(cfg, engine_factory=lambda: _StubEngine(S=1))
+    report = run_soak(
+        rs, _payloads(10, steps=4), trace, autoscaler=scaler,
+    )
+    return rs, scaler, report
+
+
+class TestVirtualTimeAutoscale:
+    def test_scale_up_under_queue_burst_zero_lost(self):
+        rs, scaler, report = _soak_with_scaler(11)
+        assert report.completed and report.lost == 0
+        ups = [e for e in scaler.decision_log() if e[1] == "up"]
+        assert ups, "the burst trace must trigger a scale-up"
+        assert len(rs.replicas) > 1
+        assert rs.metrics.autoscale_ups.value == len(ups)
+        # bounds held through the whole run
+        assert all(e[4] <= 3 for e in scaler.decision_log())
+        assert rs.healthy_replicas <= 3
+        # every recorded request reached a terminal outcome
+        assert len(report.outcomes) == 30
+
+    def test_cooldown_spaces_applied_actions(self):
+        _, scaler, _ = _soak_with_scaler(11)
+        log = scaler.decision_log()
+        ticks = [e[0] for e in log]
+        assert all(
+            b - a > 3 for a, b in zip(ticks, ticks[1:])
+        ), f"actions closer than the cooldown: {log}"
+
+    def test_replay_is_byte_identical(self):
+        _, s1, r1 = _soak_with_scaler(23)
+        _, s2, r2 = _soak_with_scaler(23)
+        assert s1.decision_log() == s2.decision_log()
+        assert s1.decision_log(), "vacuous replay — nothing was decided"
+        assert r1.decisions == r2.decisions
+        # both directions exercised: the burst scaled up, the quiet
+        # tail scaled back down — and the replay reproduced both.
+        actions = {e[1] for e in s1.decision_log()}
+        assert actions == {"up", "down"}
+
+    def test_scale_down_drain_loses_nothing(self):
+        """A scale-down with IN-FLIGHT work on the victim requeues it
+        onto survivors (the PR-4 path) and the request still serves —
+        zero loss across the drain."""
+        rs = _fleet(2)
+        cfg = AutoscaleConfig(
+            min_replicas=1, max_replicas=3, window_ticks=2,
+            cooldown_ticks=0, scale_down_occupancy=1.0,
+        )
+        scaler = Autoscaler(cfg, engine_factory=lambda: _StubEngine(S=1))
+        # Park a long decode on replica 1 (the deterministic victim:
+        # highest healthy rid) with nothing queued anywhere.
+        p = rs.submit_async({"steps": 20, "key": "drain-me"})
+        with rs._cond:
+            for rep in rs.replicas:
+                if p in rep.q:
+                    rep.q.remove(p)
+            p.rid = 1
+            rs.replicas[1].q.append(p)
+        dec1 = rs.replicas[1].decoder
+        with rs._cond:
+            pend = rs.replicas[1].q.popleft()
+        dec1.tick_begin([pend.prepared], [pend])   # now in flight
+        assert dec1.n_occupied == 1
+        # Quiet window (occupancy allowed) -> down on the 2nd step.
+        d1 = scaler.step(rs, drain_inline=True)
+        d2 = scaler.step(rs, drain_inline=True)
+        assert (d1.action, d2.action) == ("hold", "down")
+        assert not rs.replicas[1].healthy
+        assert rs.metrics.requeues_total.value == 1
+        # The survivor serves the requeued request to completion.
+        for _ in range(40):
+            if p.future.done():
+                break
+            rep0 = rs.replicas[0]
+            with rs._cond:
+                admits = [
+                    rep0.q.popleft() for _ in range(
+                        min(len(rep0.q), len(rep0.decoder.free))
+                    )
+                ]
+            handle = rep0.decoder.tick_begin(
+                [x.prepared for x in admits], admits
+            )
+            if handle is None:
+                continue
+            done = rep0.decoder.tick_wait(handle)
+            if done:
+                rs._resolve(
+                    rep0, rs.metrics.replica(0),
+                    rep0.decoder.harvest_from(handle, done),
+                )
+        assert p.future.done(), "scale-down drain lost the request"
+        assert p.future.result()["caption"] == "chaos-stub"
+        assert rs.metrics.autoscale_downs.value == 1
+
+    def test_flight_events_and_metric_families(self):
+        rs, scaler, _ = _soak_with_scaler(11)
+        events = [
+            e for e in rs.flight.snapshot()["events"]
+            if e["event"] == "autoscale"
+        ]
+        assert events, "applied decisions must land on the flight ring"
+        e = events[0]
+        assert e["tags"]["action"] in ("up", "down")
+        assert {"reason", "frm", "to", "replica"} <= set(e["tags"])
+        text = rs.metrics.to_prometheus()
+        assert "caption_autoscale_decisions_total" in text
+        assert "caption_autoscale_scale_ups_total" in text
+        assert "caption_autoscale_target_replicas" in text
+        d = rs.metrics.to_dict()
+        assert d["autoscale"]["scale_ups"] >= 1
+        assert d["autoscale"]["decisions"] >= 1
+
+    def test_live_control_thread_steps_and_stops_clean(self):
+        """The threaded mode the CaptionServer wires: the loop samples
+        on its interval, and stop() joins it — no decisions land after
+        stop returns."""
+        import time
+
+        rs = _fleet(1)
+        cfg = AutoscaleConfig(
+            window_ticks=1, cooldown_ticks=0, interval_s=0.01,
+            scale_up_queue_depth=1e9,   # never actually scales
+        )
+        scaler = Autoscaler(
+            cfg, engine_factory=lambda: _StubEngine(S=1)
+        )
+        scaler.start(rs)
+        deadline = time.monotonic() + 5.0
+        while (
+            rs.metrics.autoscale_decisions.value < 3
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.01)
+        assert rs.metrics.autoscale_decisions.value >= 3
+        scaler.stop()
+        settled = rs.metrics.autoscale_decisions.value
+        time.sleep(0.06)
+        assert rs.metrics.autoscale_decisions.value == settled
+        assert len(rs.replicas) == 1   # the threshold never tripped
+
+    def test_added_replica_is_routable_and_labeled(self):
+        rs = _fleet(1)
+        rid = rs.add_replica(_StubEngine(S=2))
+        assert rid == 1
+        assert rs.healthy_replicas == 2
+        assert rs.replicas[1].decoder.S == 2
+        # router sees it immediately: least-loaded prefers the roomier
+        # fresh replica
+        p = rs.submit_async({"steps": 1, "key": "routed"})
+        assert p.rid == 1
+        d = rs.describe()
+        assert len(d["artifact_versions"]) == 2

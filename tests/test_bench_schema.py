@@ -202,6 +202,42 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="slo_chaos_attainment"):
             validate_record(rec)
 
+    def test_coldstart_fields_pass(self):
+        """ISSUE 13: paired warm-vs-AOT cold-start rows are numeric by
+        contract (the ratio and the compile_count == 0 pin included)."""
+        rec = good_bench()
+        rec["extra"].update({
+            "coldstart_warm_s": 7.5,
+            "coldstart_aot_s": 1.7,
+            "coldstart_ratio": 4.3,
+            "coldstart_warm_boot_s": 6.4,
+            "coldstart_aot_boot_s": 1.0,
+            "coldstart_warm_compile_count": 11.0,
+            "coldstart_aot_compile_count": 0.0,
+            "coldstart_artifact_build_s": 4.9,
+            "coldstart_artifact_bytes": 1784953.0,
+            "coldstart_variants": 14.0,
+            "coldstart_tokens_match": 1.0,
+            "coldstart_host_cores": 1.0,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "fast", [1.0]])
+    def test_non_numeric_coldstart_field_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["coldstart_ratio"] = bad
+        with pytest.raises(ValueError, match="coldstart_ratio"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "0"])
+    def test_non_numeric_coldstart_bytes_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["coldstart_artifact_bytes"] = bad
+        with pytest.raises(
+            ValueError, match="coldstart_artifact_bytes"
+        ):
+            validate_record(rec)
+
     def test_mesh_shape_string_passes(self):
         """*_mesh_shape fields carry the topology a row ran on (ISSUE
         9): a "2x4"-style string in declared axis order."""
