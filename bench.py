@@ -44,7 +44,11 @@ CPU child; BENCH_SLOT_MEM_SLOTS / _CLIENTS / _REQS / _EOS_BIAS size
 it),
 BENCH_SHARD=0 to skip the paired replicated-vs-model-sharded XE rows
 (subprocess virtual-CPU child; BENCH_SHARD_N / _BATCH / _VOCAB /
-_STEPS size it), BENCH_TRACE=0 to skip the paired tracing-on/off
+_STEPS size it), BENCH_SHARD_FUSED=0 to skip the paired fused-vs-scan
+model-sharded slot-decode rows (subprocess virtual-CPU child;
+BENCH_SHARD_FUSED_N / _VOCAB / _STEPS size it — candidate-all-gather
+vs full-vocab-gather collective bytes plus steps/s under M=2),
+BENCH_TRACE=0 to skip the paired tracing-on/off
 serving rows (subprocess CPU child; BENCH_TRACE_REQS / _CLIENTS /
 _REPS size it), BENCH_SLO=0 to skip the chaos-soak/SLO-attainment
 rows (subprocess CPU child; BENCH_SLO_SEED / _REQS size it — the
@@ -189,6 +193,22 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
         # real, cache-accounted invariant pass).
         for k, v in rec["extra"].items():
             if k.startswith("analysis_") and not _is_number(v):
+                fail(f"{k!r} must be a real number, got {v!r}")
+        # Shard-fused decode rows (ISSUE 14): every shard_fused_*
+        # field is a measurement by contract — numeric, never
+        # bool/None/prose (the candidate-vs-vocab gather comparison
+        # and the fused/scan steps/s pair are only meaningful when
+        # both arms really compiled and ran).  The *_mesh_shape and
+        # provenance string fields keep their own formats below.
+        for k, v in rec["extra"].items():
+            if not k.startswith("shard_fused_"):
+                continue
+            if k.endswith(("_mesh_shape", "_xla_flags",
+                           "_jax_platforms")):
+                continue
+            if k == "shard_fused_virtual_cpu":
+                continue
+            if not _is_number(v):
                 fail(f"{k!r} must be a real number, got {v!r}")
         # Mesh topology is a machine-readable string by contract
         # (ISSUE 9): any *_mesh_shape field must look like "2x4" —
@@ -2669,6 +2689,208 @@ def bench_shard(backend_ok: bool = True):
     return out
 
 
+def _bench_shard_fused_impl():
+    """Fused-vs-scan model-sharded slot decode on a virtual 2-device
+    CPU mesh (the in-process child of :func:`bench_shard_fused`).
+
+    Same params, same requests, the SAME (data=1, model=2) mesh, two
+    compiled tick variants of the serving slot decoder: the PR-9 scan
+    path (`serving.shard_fused_decode=false` — logits constrained
+    vocab-over-model, inline `lax.top_k`, the SPMD partitioner inserts
+    the O(V) full-vocab gather every step) vs the ISSUE-14 fused path
+    (per-shard vocab-tile top-K + O(shards*K) candidate all-gather,
+    `decoding/core.py::make_tp_beam_topk`).  Records steps/s both
+    ways, the per-tick HLO all-gather bytes for both (the candidate
+    table must be STRICTLY below the vocab gather — asserted, not just
+    recorded), and a token-parity count across fused/scan/unsharded
+    (must be 0; the PARITY r15 contract measured end-to-end).
+    Virtual-CPU steps/s are not TPU steps/s; the honest
+    ``shard_fused_host_cores``/``*_mesh_shape`` fields keep the rows
+    caveated from the record alone."""
+    import copy
+
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.build import build_dataset
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    n = len(jax.devices())
+    if n < 2:
+        raise RuntimeError(
+            f"shard-fused pair needs >=2 virtual devices, have {n}"
+        )
+    # Default vocab 2048 (the bench_shard shape): the smoke dataset's
+    # ~36-word vocab would understate the O(V)-vs-O(K) gather story;
+    # extra rows beyond the real vocabulary are legal (never sampled
+    # into detokenization here — harvest compares raw token ids).
+    V = int(os.environ.get("BENCH_SHARD_FUSED_VOCAB", "2048"))
+    steps = int(os.environ.get("BENCH_SHARD_FUSED_STEPS", "16"))
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.continuous = True
+    cfg.serving.num_slots = 4
+    cfg.serving.slot_block_steps = 1
+    cfg.eval.beam_size = 3
+    cfg.eval.max_decode_len = 12
+    ds, vocab = build_dataset(cfg, cfg.eval.eval_split)
+    # Even vocab tile over the 2-way model axis (shard_decode_ok).
+    cfg.model.vocab_size = max(V, (len(vocab) + 1) // 2 * 2) // 2 * 2
+    base = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    payloads = [
+        {"features": {m: a.tolist() for m, a in ds.features(i).items()}}
+        for i in range(4)
+    ]
+
+    def build(model_shards, fused):
+        c = copy.deepcopy(cfg)
+        c.serving.model_shards = model_shards
+        c.serving.shard_fused_decode = fused
+        c.serving.replicas = 1
+        return InferenceEngine(c, params=base.params, vocab=base.vocab)
+
+    def slot_decode(eng):
+        """All payloads through the slot loop; list of token rows."""
+        dec = eng.slot_decoder()
+        prepared = [eng.prepare(p) for p in payloads]
+        out = {}
+        pending = list(range(len(prepared)))
+        while pending or dec.occupied:
+            k = min(2, len(pending), len(dec.free))
+            adm = [pending.pop(0) for _ in range(k)]
+            done = dec.tick([prepared[i] for i in adm], adm)
+            for i, tokens, _score, _steps in dec.harvest_many(done):
+                out[i] = np.asarray(tokens)
+        return [out[i] for i in range(len(prepared))]
+
+    def measure(eng):
+        dec = eng.slot_decoder()
+        tokens = slot_decode(eng)          # also warms the tick fns
+        # Keep a couple of slots occupied so the timed pure-step tick
+        # does real decode work.
+        prepared = [eng.prepare(p) for p in payloads[:2]]
+        dec.tick(prepared, [0, 1])
+        fn = dec._tick_fn(0)
+        coll = _hlo_collective_bytes(
+            fn.lower(eng.params, dec._st, None, None)
+            .compile().as_text()
+        )
+        times = []
+        for _ in range(steps):
+            t0 = time.perf_counter()
+            dec._st, done, _s, _c = fn(
+                eng.params, dec._st, None, None
+            )
+            jax.block_until_ready(done)
+            times.append(time.perf_counter() - t0)
+        dt = sorted(times)[len(times) // 2]
+        for s in list(dec.occupied):
+            dec.evict(s)
+        return {
+            "tokens": tokens,
+            "steps_per_sec": dec.block / dt,
+            "all_gather_bytes": coll["all-gather"],
+            "collective_bytes": coll["total"],
+            "mesh_shape": eng.describe()["mesh_shape"],
+        }
+
+    ref = slot_decode(build(1, False))         # unsharded truth
+    scan = measure(build(2, False))
+    fused = measure(build(2, True))
+
+    mismatches = 0
+    for arm in (scan["tokens"], fused["tokens"]):
+        for a, b in zip(arm, ref):
+            if not np.array_equal(a, b):
+                mismatches += 1
+    if mismatches:
+        raise RuntimeError(
+            f"shard-fused decode diverged from the unsharded slot "
+            f"path on {mismatches} request(s) — the PARITY r15 "
+            "contract is broken; do not record perf for wrong tokens"
+        )
+    if not fused["all_gather_bytes"] < scan["all_gather_bytes"]:
+        raise RuntimeError(
+            "candidate all-gather bytes "
+            f"({fused['all_gather_bytes']}) not strictly below the "
+            f"full-vocab gather ({scan['all_gather_bytes']}) — the "
+            "fused merge is not engaging"
+        )
+    return {
+        "shard_fused_virtual_devices": n,
+        "shard_fused_host_cores": float(os.cpu_count() or 1),
+        "shard_fused_xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "shard_fused_jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "shard_fused_mesh_shape": fused["mesh_shape"],
+        "shard_fused_vocab": cfg.model.vocab_size,
+        "shard_fused_beam": cfg.eval.beam_size,
+        "shard_fused_slots": cfg.serving.num_slots,
+        "shard_fused_steps_per_sec": round(fused["steps_per_sec"], 3),
+        "shard_fused_scan_steps_per_sec": round(
+            scan["steps_per_sec"], 3
+        ),
+        "shard_fused_vs_scan_ratio": round(
+            fused["steps_per_sec"] / scan["steps_per_sec"], 4
+        ),
+        # The collective-layout headline: per-tick all-gather bytes of
+        # the candidate merge vs the forbidden full-vocab gather.
+        "shard_fused_candidate_all_gather_bytes": fused[
+            "all_gather_bytes"
+        ],
+        "shard_fused_scan_all_gather_bytes": scan["all_gather_bytes"],
+        "shard_fused_gather_ratio": round(
+            fused["all_gather_bytes"] / max(scan["all_gather_bytes"], 1),
+            6,
+        ),
+        "shard_fused_collective_bytes": fused["collective_bytes"],
+        "shard_fused_scan_collective_bytes": scan["collective_bytes"],
+        "shard_fused_token_mismatches": mismatches,
+    }
+
+
+def bench_shard_fused(backend_ok: bool = True):
+    """Fused-vs-scan model-sharded slot-decode pair (see
+    :func:`_bench_shard_fused_impl`).  Runs inline on a >=2-device
+    host, otherwise re-execs onto a virtual 2-device CPU platform —
+    the pair must measure real cross-device collectives, not one
+    device pretending."""
+    import subprocess
+
+    if backend_ok:
+        try:
+            if len(jax.devices()) >= 2:
+                return _bench_shard_fused_impl()
+        except Exception:  # noqa: BLE001 — fall through to the child
+            pass
+    env = dict(os.environ)
+    n = int(env.get("BENCH_SHARD_FUSED_N", "0")) or 2
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={n}"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SHARD_FUSED_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"shard-fused pair child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    out = json.loads(lines[-1])
+    out["shard_fused_virtual_cpu"] = True
+    return out
+
+
 def bench_loader():
     """Host batch assembly from the packed feature store at MSR-VTT shape
     (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
@@ -3103,6 +3325,16 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["shard_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_SHARD_FUSED", "1") == "1":
+        # Paired fused-vs-scan model-sharded slot-decode rows (ISSUE
+        # 14): candidate-all-gather vs full-vocab-gather collective
+        # bytes + steps/s under M=2 on a virtual 2-device CPU mesh,
+        # token parity asserted before anything is recorded.
+        try:
+            extra.update(bench_shard_fused(backend_ok=ok))
+        except Exception as e:  # noqa: BLE001
+            extra["shard_fused_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if os.environ.get("BENCH_LOADER", "1") == "1":
         # Host-only bench: runs even when the device backend is down.
         try:
@@ -3203,6 +3435,12 @@ if __name__ == "__main__":
         # config update so a sitecustomize platform pin can't win.
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_shard_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_SHARD_FUSED_CHILD") == "1":
+        # Re-exec'd fused-vs-scan model-sharded slot-decode child
+        # (bench_shard_fused), same virtual-platform discipline.
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_shard_fused_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_REPLICA_CHILD") == "1":
         # Re-exec'd replica-sweep child (bench_serving_replicas): the

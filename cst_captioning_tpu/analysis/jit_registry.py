@@ -170,6 +170,69 @@ AOT_SITE_REGISTRY: Dict[str, str] = {
 }
 
 
+# Every ``shard_map`` call site in the package (raw jax API, the
+# ``parallel/mesh.py`` version-compat wrapper, or its resolved
+# ``_shard_map_impl``), keyed ``<file>::<enclosing qualname>`` —
+# CST-SHD-004 fails the pass on any unregistered site and on stale
+# entries.  The value is reviewer-facing prose: the COLLECTIVE LAYOUT
+# the manual specs buy (which per-step gather they avoid) and what
+# bounds the site's recompiles.  A shard_map with no story is usually
+# a partitioner workaround nobody can maintain.
+SHARD_MAP_REGISTRY: Dict[str, str] = {
+    "parallel/mesh.py::shard_map": (
+        "the version-portability wrapper every package shard_map routes "
+        "through (jax.experimental vs top-level spelling, check_rep vs "
+        "check_vma) — the one raw-impl call site, no collective layout "
+        "of its own"
+    ),
+    "parallel/ring.py::ring_attention": (
+        "ring attention: frame-axis K/V shards rotate via "
+        "collective_permute so each device scores S/M frames per hop "
+        "instead of all-gathering the full frame axis; one compile per "
+        "(mesh, block shape)"
+    ),
+    "parallel/ring.py::sharded_context_attention": (
+        "single-query Bahdanau fusion with frames sharded over `model`: "
+        "local score + one psum of the (B, E) context instead of every "
+        "device holding all frames; one compile per (mesh, shape)"
+    ),
+    "training/cst.py::_make_one_graph_step.score": (
+        "per-shard CST reward io_callback: each shard scores its own "
+        "rollout rows host-side — the replicated-global fallback would "
+        "funnel every row through device 0; one compile per CST batch "
+        "shape"
+    ),
+    "decoding/core.py::make_tp_beam_topk.topk": (
+        "the ISSUE-14 cross-shard beam top-K: per-shard vocab-tile "
+        "candidates + one O(shards*K) all-gather replace the O(V) "
+        "full-vocab gather the SPMD partitioner inserts for the inline "
+        "lax.top_k over model-sharded logits; compiled inside the "
+        "warmup-bounded slot tick variants"
+    ),
+    "decoding/core.py::make_tp_row_pick.pick": (
+        "the greedy twin of make_tp_beam_topk: per-shard argmax "
+        "(value, global id) pairs merged by one tiny all-gather instead "
+        "of gathering the (rows, V) logits; compiled inside the "
+        "warmup-bounded slot tick variants"
+    ),
+    "ops/shard_decode.py::_sharded_beam_impl": (
+        "the shard_map port of the fused beam kernel: vocab-over-model "
+        "in_specs keep each shard on its (H, V/M) w_out tile, the "
+        "per-step candidate all-gather is O(shards*K) bytes vs the "
+        "forbidden O(V) gather, and the embedding feed is a masked "
+        "lookup + (rows, E) psum; one compile per (mesh, beam, L) "
+        "decode configuration like the kernel it ports"
+    ),
+    "ops/shard_decode.py::_sharded_sample_impl": (
+        "the shard_map port of the fused sampler: same tile layout as "
+        "the beam port with per-shard Gumbel-max winners (global-id "
+        "counters keep the hash stream shard-invariant) merged by one "
+        "tiny all-gather; one compile per (mesh, T, greedy) "
+        "configuration"
+    ),
+}
+
+
 # Every ``with_sharding_constraint`` site in the package (and every call
 # through ``parallel/partition.py::constrain``), keyed
 # ``<file>::<enclosing qualname>`` — CST-SHD-002 fails the pass on any

@@ -22,11 +22,25 @@ docs/ANALYSIS.md):
   already covered by CST-DON-002.
 * **CST-SHD-003** — a rule whose regex matches NO known leaf is stale:
   the tensor it governed was renamed or removed.
+* **CST-SHD-004** — every ``shard_map`` call site (raw jax API, the
+  ``parallel/mesh.py`` compat wrapper, or its ``_shard_map_impl``
+  indirection) must be registered in ``analysis/jit_registry.py::
+  SHARD_MAP_REGISTRY`` with a prose justification of the collective
+  layout it buys (which per-step gather the manual specs avoid, what
+  bounds its recompiles); stale entries fire too.  A shard_map with no
+  story is usually a partitioner workaround nobody can maintain.
+* **CST-SHD-005** — the fused-decode kernel GATE must be table-driven:
+  ``DECODE_KERNEL_CAPS`` (decoding/core.py) must be a literal dict
+  covering every ``use_pallas_*`` field ``ModelConfig`` declares (and
+  naming no undeclared flag — stale rows fire), and any module
+  defining a ``_decode_kernel_gate`` function must route it through
+  ``kernel_supports`` — an ad-hoc mesh condition in the gate is
+  exactly the hardcoded refusal ISSUE 14 removed.
 
 The checker is table-driven off the AST (``ast.literal_eval`` of the
-two module-level assignments), so it runs jax-free like every other
-family, and it applies to ANY scanned module defining both names — the
-corpus seeds violations in a toy table without touching the real one.
+module-level assignments), so it runs jax-free like every other
+family, and it applies to ANY scanned module defining the names — the
+corpus seeds violations in toy tables without touching the real ones.
 """
 
 from __future__ import annotations
@@ -154,6 +168,161 @@ def _is_constraint_call(node: ast.Call) -> bool:
     return last == _RAW_CONSTRAINT or last in _HELPER_NAMES
 
 
+# Call names that ARE a shard_map entry: the raw/top-level jax API, the
+# parallel/mesh.py version-compat wrapper, and the wrapper's resolved
+# implementation alias.
+_SHARD_MAP_NAMES = ("shard_map", "_shard_map_impl")
+
+
+def _is_shard_map_call(node: ast.Call) -> bool:
+    name = call_name(node)
+    if not name:
+        return False
+    return name.rsplit(".", 1)[-1] in _SHARD_MAP_NAMES
+
+
+def _check_shard_map_sites(
+    mi: ModuleInfo, seen: Dict[str, Tuple[str, int, str]]
+) -> List[Finding]:
+    out: List[Finding] = []
+    flagged = set()
+    for node in ast.walk(mi.tree):
+        if not (isinstance(node, ast.Call) and _is_shard_map_call(node)):
+            continue
+        sym = mi.qualname_of(node)
+        key = f"{mi.rel}::{sym}"
+        seen[key] = (mi.rel, node.lineno, sym)
+        if key in jit_registry.SHARD_MAP_REGISTRY:
+            continue
+        if key in flagged:
+            continue
+        flagged.add(key)
+        out.append(Finding(
+            "CST-SHD-004", mi.rel, node.lineno, sym,
+            f"shard_map site `{key}` is not registered — add it to "
+            "analysis/jit_registry.py::SHARD_MAP_REGISTRY with the "
+            "collective layout it buys (which per-step gather the "
+            "manual specs avoid) and what bounds its recompiles",
+        ))
+    return out
+
+
+# ----------------------------------------- kernel-gate capability table
+
+CAPS_NAME = "DECODE_KERNEL_CAPS"
+_CAPS_AXES = ("model", "data")
+_GATE_FN = "_decode_kernel_gate"
+_CAPS_LOOKUP = "kernel_supports"
+
+
+def _caps_table(node: ast.Assign, mi: ModuleInfo) -> Optional[dict]:
+    try:
+        val = ast.literal_eval(node.value)
+    except (ValueError, SyntaxError):
+        return None
+    if not isinstance(val, dict):
+        return None
+    for flag, caps in val.items():
+        if not (
+            isinstance(flag, str)
+            and isinstance(caps, dict)
+            and set(caps) == set(_CAPS_AXES)
+            and all(isinstance(v, bool) for v in caps.values())
+        ):
+            return None
+    return val
+
+
+def _model_config_flags(mi: ModuleInfo) -> Optional[List[str]]:
+    """``use_pallas_*`` field names of a ``class ModelConfig`` in this
+    module, or None when the module declares no such class."""
+    for node in ast.walk(mi.tree):
+        if isinstance(node, ast.ClassDef) and node.name == "ModelConfig":
+            flags = []
+            for stmt in node.body:
+                if isinstance(stmt, ast.AnnAssign) and isinstance(
+                    stmt.target, ast.Name
+                ):
+                    if stmt.target.id.startswith("use_pallas_"):
+                        flags.append(stmt.target.id)
+            return flags
+    return None
+
+
+def _gate_functions(mi: ModuleInfo) -> List[ast.FunctionDef]:
+    return [
+        node for node in ast.walk(mi.tree)
+        if isinstance(node, ast.FunctionDef) and node.name == _GATE_FN
+    ]
+
+
+def _check_kernel_caps(modules: List[ModuleInfo]) -> List[Finding]:
+    """CST-SHD-005: cross-module capability-table discipline (see the
+    module doc).  Only judged when a scanned module defines the table —
+    a corpus scan seeds its own toy table + ModelConfig + gate."""
+    tables: List[Tuple[ModuleInfo, ast.Assign, Optional[dict]]] = []
+    flags: Optional[List[str]] = None
+    gates: List[Tuple[ModuleInfo, ast.FunctionDef]] = []
+    for mi in modules:
+        node = _module_assign(mi, CAPS_NAME)
+        if node is not None:
+            tables.append((mi, node, _caps_table(node, mi)))
+        f = _model_config_flags(mi)
+        if f is not None:
+            flags = (flags or []) + f
+        for g in _gate_functions(mi):
+            gates.append((mi, g))
+    if not tables:
+        return []
+    out: List[Finding] = []
+    caps: dict = {}
+    for mi, node, parsed in tables:
+        if parsed is None:
+            out.append(Finding(
+                "CST-SHD-005", mi.rel, node.lineno, "<module>",
+                f"{CAPS_NAME} must be a literal dict of "
+                "{'use_pallas_*': {'model': bool, 'data': bool}} the "
+                "jax-free pass can read off the AST",
+            ))
+        else:
+            caps.update(parsed)
+            caps_mi, caps_node = mi, node
+    if flags is not None and caps:
+        for flag in flags:
+            if flag not in caps:
+                out.append(Finding(
+                    "CST-SHD-005", caps_mi.rel, caps_node.lineno,
+                    CAPS_NAME,
+                    f"kernel flag {flag!r} (ModelConfig) has no "
+                    f"{CAPS_NAME} row — every fused-kernel gate "
+                    "decision must come from the table, not an ad-hoc "
+                    "mesh condition",
+                ))
+        for flag in caps:
+            if flag not in flags:
+                out.append(Finding(
+                    "CST-SHD-005", caps_mi.rel, caps_node.lineno,
+                    CAPS_NAME,
+                    f"stale {CAPS_NAME} row {flag!r} names no declared "
+                    "ModelConfig flag — the kernel it gated was "
+                    "renamed or removed",
+                ))
+    for mi, g in gates:
+        calls = [
+            n for n in ast.walk(g)
+            if isinstance(n, ast.Call)
+            and (call_name(n) or "").rsplit(".", 1)[-1] == _CAPS_LOOKUP
+        ]
+        if not calls:
+            out.append(Finding(
+                "CST-SHD-005", mi.rel, g.lineno, mi.qualname_of(g),
+                f"{_GATE_FN} never consults {_CAPS_LOOKUP} — the gate "
+                f"condition must be driven by {CAPS_NAME}, not a "
+                "hardcoded mesh check (the ISSUE-14 contract)",
+            ))
+    return out
+
+
 def _check_constraint_sites(
     mi: ModuleInfo, seen: Dict[str, Tuple[str, int, str]]
 ) -> List[Finding]:
@@ -184,11 +353,14 @@ def _check_constraint_sites(
 def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
     out: List[Finding] = []
     seen: Dict[str, Tuple[str, int, str]] = {}
+    seen_sm: Dict[str, Tuple[str, int, str]] = {}
     scanned_rels = set()
     for mi in modules:
         scanned_rels.add(mi.rel)
         out.extend(_check_rule_tables(mi))
         out.extend(_check_constraint_sites(mi, seen))
+        out.extend(_check_shard_map_sites(mi, seen_sm))
+    out.extend(_check_kernel_caps(modules))
     # Stale registry entries: only judged for files this scan actually
     # covered (a corpus scan must not flag the real package's entries).
     for key in sorted(jit_registry.SHARDING_CONSTRAINT_REGISTRY):
@@ -198,5 +370,13 @@ def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
                 "CST-SHD-002", "analysis/jit_registry.py", 1, key,
                 f"stale sharding-constraint registry entry `{key}` "
                 "matches no site — the code moved; update or remove it",
+            ))
+    for key in sorted(jit_registry.SHARD_MAP_REGISTRY):
+        rel = key.split("::", 1)[0]
+        if rel in scanned_rels and key not in seen_sm:
+            out.append(Finding(
+                "CST-SHD-004", "analysis/jit_registry.py", 1, key,
+                f"stale shard_map registry entry `{key}` matches no "
+                "site — the code moved; update or remove it",
             ))
     return out

@@ -41,17 +41,23 @@ from cst_captioning_tpu.analysis.engine import (
 TOP_K_ALLOWED = frozenset({
     "decoding/core.py",
     "ops/pallas_beam.py",
+    # The shard_map port of the fused kernels (ISSUE 14): per-shard
+    # vocab-tile top-K feeding the cross-shard candidate merge — the
+    # same conscious kernel-twin exemption as the Pallas files.
+    "ops/shard_decode.py",
 })
 FINISH_ALLOWED = frozenset({
     "decoding/core.py",
     "ops/pallas_beam.py",
     "ops/pallas_sampler.py",
+    "ops/shard_decode.py",
 })
 # training/cst.py: the PG update's input shift, not a decode loop.
 FEED_ALLOWED = frozenset({
     "decoding/core.py",
     "ops/pallas_beam.py",
     "ops/pallas_sampler.py",
+    "ops/shard_decode.py",
     "training/cst.py",
 })
 # Allowed jnp.repeat fan-outs: the offline beam expansion (beam.py),
@@ -62,6 +68,7 @@ REPEAT_ALLOWED = frozenset({
     "decoding/beam.py",
     "models/captioner.py",
     "ops/pallas_beam.py",
+    "ops/shard_decode.py",
     "training/cst.py",
     "serving/slots.py",
 })

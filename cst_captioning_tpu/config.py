@@ -309,12 +309,26 @@ class ServingConfig:
     # with_sharding_constraint over the model axis, slot/decode state
     # stays replicated across the shard group (the data axis is 1).
     # 1 = today's per-device replica scaling, byte-identical to the
-    # pre-TP engine; > 1 requires replicas == 1 and at least that many
-    # local devices.  Decoded tokens are exact vs model_shards=1: the
+    # pre-TP engine; > 1 composes with `replicas` into an (R data-
+    # parallel replicas) x (M model shards) serving grid: each replica
+    # is a model-sharded engine on its own deterministic (1, M)
+    # submesh of id-sorted local devices, and R*M must fit the local
+    # device count (replicas=0 means one sharded replica per M
+    # devices).  Decoded tokens are exact vs model_shards=1: the
     # column-sharded vocab matmul computes each logit column with the
     # same reduction order as the replicated layout (docs/PARITY.md
-    # r12).
+    # r12/r15).
     model_shards: int = 1
+    # Cross-shard fused top-K for the model-sharded slot decode
+    # (decoding/core.py::make_tp_beam_topk / make_tp_row_pick): each
+    # shard top-Ks its own vocab tile and one O(shards*K) candidate
+    # all-gather merges them — instead of the O(V) full-vocab gather
+    # XLA inserts for the inline top-K over sharded logits.  Token-
+    # exact incl. tie order (docs/PARITY.md r15; the tp2_fused
+    # backends pin it in the shared harness).  Requires the vocab to
+    # divide model_shards — uneven tiles log a warning and keep the
+    # gather path.  False = the PR-9 gather path (paired bench rows).
+    shard_fused_decode: bool = True
     # Router policy across replica admission queues: "least_loaded"
     # (most free slots minus queued work wins, round-robin tiebreak) or
     # "round_robin".
@@ -610,11 +624,26 @@ def _preset_msrvtt_serve_tp() -> Config:
     a (data=1, model=2) mesh instead of two independent clones — halves
     the per-device vocab-param footprint, serves bigger decoders than
     one device holds.  Token-exact vs the replicated engine
-    (docs/PARITY.md r12)."""
+    (docs/PARITY.md r12); the slot decode's per-step top-K runs the
+    cross-shard fused candidate merge (shard_fused_decode, PARITY
+    r15)."""
     c = _preset_msrvtt_serve()
     c.name = "msrvtt_serve_tp2"
     c.serving.replicas = 1
     c.serving.model_shards = 2
+    return c
+
+
+def _preset_msrvtt_serve_grid() -> Config:
+    """Replica x shard serving grid: R=2 data-parallel replicas OF
+    M=2-way model-sharded engines — one config, four devices, both
+    axes (ISSUE 14).  Each replica lives on its own deterministic
+    (1, 2) submesh of the id-sorted local devices; the router,
+    hedging, requeue, and autoscaling machinery see ordinary replicas
+    whose insides happen to be sharded."""
+    c = _preset_msrvtt_serve_tp()
+    c.name = "msrvtt_serve_r2xtp2"
+    c.serving.replicas = 2
     return c
 
 
@@ -661,6 +690,7 @@ PRESETS = {
     "msrvtt_serve_beam5": _preset_msrvtt_serve,
     "msrvtt_xe_2d": _preset_msrvtt_xe_2d,
     "msrvtt_serve_tp2": _preset_msrvtt_serve_tp,
+    "msrvtt_serve_r2xtp2": _preset_msrvtt_serve_grid,
     "synthetic_smoke": _preset_synthetic_smoke,
 }
 

@@ -96,6 +96,20 @@ def fused_beam_engaged(
             f"shard_frames={model.shard_frames} (kernel covers "
             "single-layer unsharded decoders)"
         )
+    if getattr(model, "decode_shards", 1) > 1:
+        # Tensor-parallel port (ops/shard_decode.py): pure XLA, so the
+        # Pallas VMEM/lane-width gate doesn't apply — only the even
+        # vocab tiling does.
+        from cst_captioning_tpu.ops.shard_decode import shard_decode_ok
+
+        if shard_decode_ok(
+            model.vocab_size, model.decode_shards, beam_size
+        ):
+            return True, ""
+        return False, (
+            f"vocab {model.vocab_size} does not tile evenly over the "
+            f"{model.decode_shards}-way model axis"
+        )
     from cst_captioning_tpu.ops.pallas_beam import beam_shapes_ok
 
     B = feats[model.modalities[0]].shape[0]

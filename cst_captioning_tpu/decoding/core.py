@@ -51,7 +51,7 @@ change any sampled token (docs/PARITY.md "slot rollout invariance").
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, NamedTuple, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -59,6 +59,36 @@ import jax.numpy as jnp
 from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
 
 NEG_INF = -1e30
+
+
+# ------------------------------------------------- kernel capability table
+#
+# Which mesh axes each fused decode kernel's fast path survives — THE
+# machine-checked source the kernel gates consult (CST-SHD-005 fails the
+# analysis pass if a `use_pallas_*` ModelConfig flag has no row here, if
+# a row names no declared flag, or if the gate in models/captioner.py
+# stops routing through :func:`kernel_supports`).  A literal dict on
+# purpose: the jax-free analysis pass reads it straight off the AST.
+#
+# "model": the kernel (or its shard_map port, ops/shard_decode.py) can
+# run with the vocab sharded over the mesh `model` axis — per-shard
+# vocab-tile streaming with a cross-shard top-K candidate merge.
+# "data": the kernel can run inside a batch-sharded (data > 1) jit —
+# none can today (pallas_call has no SPMD partitioning rule and no
+# shard_map port exists for the batch axis).
+DECODE_KERNEL_CAPS = {
+    "use_pallas_lstm": {"model": False, "data": False},
+    "use_pallas_attention": {"model": False, "data": False},
+    "use_pallas_sampler": {"model": True, "data": False},
+    "use_pallas_beam": {"model": True, "data": False},
+}
+
+
+def kernel_supports(flag: str, axis: str) -> bool:
+    """True when the fused path behind ``use_pallas_*`` flag ``flag``
+    survives sharding over mesh ``axis`` (see DECODE_KERNEL_CAPS)."""
+    caps = DECODE_KERNEL_CAPS.get(flag)
+    return bool(caps and caps.get(axis, False))
 
 
 class DecodeState(NamedTuple):
@@ -125,6 +155,8 @@ def decode_step(
     mode: str,
     temperature: float = 1.0,
     sample_fn: Optional[Callable] = None,
+    topk_fn: Optional[Callable] = None,
+    pick_fn: Optional[Callable] = None,
 ) -> CoreState:
     """One decode step over every row of ``st`` — the single
     definition site of the per-step recurrence.
@@ -148,6 +180,16 @@ def decode_step(
       uses ``jax.random.categorical`` on ``st.rng`` — the legacy
       threefry batch stream of ``CaptionModel._sample_from_cache``.
 
+    ``topk_fn`` (beam) / ``pick_fn`` (greedy) swap the candidate
+    SELECTION for an equivalent implementation — the tensor-parallel
+    cross-shard merge (:func:`make_tp_beam_topk` /
+    :func:`make_tp_row_pick`) that avoids materializing or gathering
+    the full-vocab logits on any one shard.  The recurrence around the
+    selection (parent gather, finish update, PAD→EOS feed) stays HERE,
+    the single definition site.  ``topk_fn(logits, st) ->
+    (top_scores (G, K), top_flat (G, K) flat ``k*V + v`` keys)``;
+    ``pick_fn(logits) -> (next_token (G,), its log-prob (G,))``.
+
     Every op is row-independent, so co-resident rows (and admission
     order, in slot consumers) cannot change any row's numbers — the
     PR-3 parity argument, now made once, here (docs/PARITY.md).
@@ -158,16 +200,19 @@ def decode_step(
     if mode == "beam":
         state, logits = step_logits(st.state, st.tokens)
         V = logits.shape[-1]
-        logp = jax.nn.log_softmax(logits, axis=-1).reshape(G, K, V)
-        # Frozen finished beams: only PAD continuation, at zero cost.
-        pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
-        logp = jnp.where(
-            st.finished[..., None], pad_only[None, None, :], logp
-        )
-        total = st.scores[..., None] + logp                 # (G, K, V)
-        top_scores, top_flat = jax.lax.top_k(
-            total.reshape(G, K * V), K
-        )                                                    # (G, K)
+        if topk_fn is not None:
+            top_scores, top_flat = topk_fn(logits, st)       # (G, K)
+        else:
+            logp = jax.nn.log_softmax(logits, axis=-1).reshape(G, K, V)
+            # Frozen finished beams: only PAD continuation, zero cost.
+            pad_only = jnp.full((V,), NEG_INF).at[PAD_ID].set(0.0)
+            logp = jnp.where(
+                st.finished[..., None], pad_only[None, None, :], logp
+            )
+            total = st.scores[..., None] + logp             # (G, K, V)
+            top_scores, top_flat = jax.lax.top_k(
+                total.reshape(G, K * V), K
+            )                                                # (G, K)
         parent = top_flat // V                               # (G, K)
         tok = (top_flat % V).astype(jnp.int32)               # (G, K)
         g_ix = jnp.arange(G)[:, None]
@@ -197,9 +242,13 @@ def decode_step(
     if mode == "sample" and rng is not None:
         rng, key = jax.random.split(rng)
     state, logits = step_logits(st.state, st.tokens)
-    if mode == "greedy":
+    if mode == "greedy" and pick_fn is not None:
+        nxt, tok_lp = pick_fn(logits)
+        nxt = nxt.astype(jnp.int32)
+    elif mode == "greedy":
         logp = jax.nn.log_softmax(logits, axis=-1)
         nxt = jnp.argmax(logp, axis=-1).astype(jnp.int32)    # (G,)
+        tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
     else:
         scaled = logits / jnp.asarray(temperature, jnp.float32)
         logp = jax.nn.log_softmax(scaled, axis=-1)
@@ -207,7 +256,7 @@ def decode_step(
             nxt = jax.random.categorical(key, scaled).astype(jnp.int32)
         else:
             nxt = sample_fn(scaled, key, st).astype(jnp.int32)
-    tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+        tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
     valid = ~st.finished[:, 0]                               # live rows
     out_tok = jnp.where(valid, nxt, PAD_ID)
     out_lp = jnp.where(valid, tok_lp, 0.0)
@@ -228,6 +277,146 @@ def decode_step(
 def all_done(st: CoreState) -> jax.Array:
     """Scalar bool: every row of every group has finished."""
     return jnp.all(st.finished)
+
+
+# ------------------------------------- tensor-parallel candidate merge
+#
+# The cross-shard top-K that unlocks the fused/TP decode fast path
+# (ISSUE 14): with the (rows, V) decode-step logits sharded
+# vocab-over-model, the inline `lax.top_k(total.reshape(G, K*V), K)`
+# above forces the SPMD partitioner to all-gather the full vocab axis
+# onto every shard — O(V) bytes per step on the hottest serving op.
+# These factories build drop-in `topk_fn`/`pick_fn` hooks that keep
+# every shard on its own vocab tile: per-shard top-K candidates (with
+# GLOBAL flat keys), one `jax.lax.all_gather` of the (K, 2)-shaped
+# candidate tables — O(shards·K) bytes — and a deterministic
+# tie-order-preserving re-top-K of the union.  Selection is exact: any
+# global top-K element is necessarily inside its shard's local top-K,
+# per-shard `lax.top_k` breaks ties by the lowest local flat index
+# (which maps monotonically to the lowest GLOBAL flat key within a
+# shard), and the union re-ranks by (value desc, key asc) — precisely
+# the inline `lax.top_k` order over the full (G, K*V) array
+# (docs/PARITY.md r15).  The residual daylight is the log-softmax
+# normalizer: the per-shard partial sums fold through one psum whose
+# association differs from the single-pass `jax.nn.log_softmax` sum at
+# the last ulp — a per-row constant shift, pinned token-exact in the
+# shared harness including exact-tie columns spanning shard boundaries.
+
+
+def _merge_candidates(values: jax.Array, keys: jax.Array, k: int):
+    """Exact top-``k`` of a small candidate union by (value desc, key
+    asc) — `jax.lax.top_k`'s tie order over values laid out in
+    ascending-key positions.  ``values``/``keys``: (G, W)."""
+    order = jnp.lexsort((keys, -values), axis=-1)[:, :k]
+    g_ix = jnp.arange(values.shape[0])[:, None]
+    return values[g_ix, order], keys[g_ix, order]
+
+
+def make_tp_beam_topk(mesh, axis: str = "model") -> Callable:
+    """Build a beam-mode ``topk_fn`` for :func:`decode_step` that merges
+    per-shard top-K candidates over the mesh ``axis`` instead of
+    all-gathering the vocab (see the block comment above).  The logits
+    handed to it must be the decode-policy (rows, V) float32 logits with
+    V divisible by the axis size — callers gate on that."""
+    from jax.sharding import PartitionSpec as P
+
+    from cst_captioning_tpu.parallel.mesh import shard_map
+
+    M = mesh.shape[axis]
+
+    def topk(logits: jax.Array, st: CoreState) -> Tuple[jax.Array, jax.Array]:
+        G, K = st.finished.shape
+        V = logits.shape[-1]
+
+        def body(lg, scores, finished):
+            # lg: this shard's (G*K, Vloc) logits tile.
+            Vloc = lg.shape[-1]
+            shard = jax.lax.axis_index(axis)
+            col0 = shard * Vloc
+            # Exact global log-softmax stats: the max is order-invariant
+            # across shards; the normalizer folds per-shard partial sums
+            # through one psum (fixed association, PARITY r15).
+            gmax = jax.lax.pmax(
+                jnp.max(lg, axis=-1, keepdims=True), axis
+            )
+            gsum = jax.lax.psum(
+                jnp.sum(jnp.exp(lg - gmax), axis=-1, keepdims=True), axis
+            )
+            logp = ((lg - gmax) - jnp.log(gsum)).reshape(G, K, Vloc)
+            # Frozen finished beams: PAD-only continuation at zero cost.
+            # The global PAD column lives on exactly one shard; every
+            # other shard's tile collapses to NEG_INF.
+            gcol = col0 + jax.lax.broadcasted_iota(
+                jnp.int32, (G, K, Vloc), 2
+            )
+            pad_only = jnp.where(gcol == PAD_ID, 0.0, NEG_INF)
+            logp = jnp.where(finished[..., None], pad_only, logp)
+            total = scores[..., None] + logp                # (G, K, Vloc)
+            loc_sc, loc_flat = jax.lax.top_k(
+                total.reshape(G, K * Vloc), K
+            )
+            # Local flat key k*Vloc + v -> GLOBAL flat key k*V + v_glob
+            # (monotone within a shard, so local tie order is preserved).
+            lk = loc_flat // Vloc
+            gkey = lk * V + (col0 + loc_flat - lk * Vloc)
+            # The O(shards*K) collective: (M, G, K) candidate tables.
+            cand_sc = jnp.moveaxis(
+                jax.lax.all_gather(loc_sc, axis), 0, 1
+            ).reshape(G, M * K)
+            cand_key = jnp.moveaxis(
+                jax.lax.all_gather(gkey, axis), 0, 1
+            ).reshape(G, M * K)
+            return _merge_candidates(cand_sc, cand_key, K)
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, axis), P(), P()),
+            out_specs=(P(), P()),
+            check_rep=False,   # outputs are replicated by construction
+        )(logits, st.scores, st.finished)
+
+    return topk
+
+
+def make_tp_row_pick(mesh, axis: str = "model") -> Callable:
+    """Greedy-mode ``pick_fn`` twin of :func:`make_tp_beam_topk`: each
+    shard takes the argmax of its local log-softmax tile, and one
+    all-gather of the (value, global id) pairs picks the global winner
+    by (value desc, id asc) — `jnp.argmax`'s lowest-index tie order."""
+    from jax.sharding import PartitionSpec as P
+
+    from cst_captioning_tpu.parallel.mesh import shard_map
+
+    def pick(logits: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        def body(lg):
+            # lg: (G, Vloc) local tile of the decode-policy logits.
+            Vloc = lg.shape[-1]
+            shard = jax.lax.axis_index(axis)
+            gmax = jax.lax.pmax(
+                jnp.max(lg, axis=-1, keepdims=True), axis
+            )
+            gsum = jax.lax.psum(
+                jnp.sum(jnp.exp(lg - gmax), axis=-1, keepdims=True), axis
+            )
+            logp = (lg - gmax) - jnp.log(gsum)
+            loc_arg = jnp.argmax(logp, axis=-1)
+            loc_val = jnp.take_along_axis(
+                logp, loc_arg[:, None], axis=-1
+            )[:, 0]
+            gid = shard * Vloc + loc_arg.astype(jnp.int32)
+            vals = jnp.moveaxis(jax.lax.all_gather(loc_val, axis), 0, 1)
+            ids = jnp.moveaxis(jax.lax.all_gather(gid, axis), 0, 1)
+            best_v, best_i = _merge_candidates(vals, ids, 1)
+            return best_i[:, 0].astype(jnp.int32), best_v[:, 0]
+
+        return shard_map(
+            body, mesh=mesh,
+            in_specs=(P(None, axis),),
+            out_specs=(P(), P()),
+            check_rep=False,
+        )(logits)
+
+    return pick
 
 
 def row_sample_fn(
@@ -305,6 +494,7 @@ _BACKEND_MODULES = (
     "cst_captioning_tpu.models.captioner",
     "cst_captioning_tpu.ops.pallas_beam",
     "cst_captioning_tpu.ops.pallas_sampler",
+    "cst_captioning_tpu.ops.shard_decode",
     "cst_captioning_tpu.serving.slots",
     "cst_captioning_tpu.training.cst",
 )

@@ -1,0 +1,561 @@
+"""shard_map port of the fused decode kernels: cross-shard top-K merge.
+
+PR 9 shipped vocab-over-model tensor parallelism but gated the fused
+whole-recurrence beam/sampler kernels OFF under ``model_shards > 1``:
+their in-kernel online top-K streams the FULL vocab through one core's
+VMEM, and a vocab-sharded layout hands each shard only V/M columns.
+This module is the port that removes the gate (ISSUE 14), following the
+Mesh-TensorFlow / pjit collective layout (PAPERS.md): the decode
+recurrence runs under ``shard_map`` over the mesh ``model`` axis, and
+
+* each shard streams ONLY its vocab tile — the (H, V/M) ``w_out``
+  columns, (V/M,) bias slice, and (V/M, E) embedding rows it owns
+  (the ``parallel/partition.py`` rule-table layout, so no resharding
+  happens at entry);
+* each step emits a per-shard top-K candidate table (beam: the shard's
+  K best ``(total, flat key)`` pairs per row via the kernels' exact
+  ``_row_topk`` tie order; sampler: the shard's Gumbel-max / argmax
+  winner triple) — O(K) values per shard instead of O(V) logits;
+* one ``jax.lax.all_gather`` of those (K, 2) tables — O(shards·K)
+  bytes — plus a deterministic (value desc, global key asc) re-top-K
+  of the union reproduces the single-device selection EXACTLY (any
+  global top-K element is inside its shard's local top-K; ties break
+  by global flat key exactly like ``lax.top_k`` over the full array);
+* the next-token embedding gather under the row-sharded table is a
+  masked local lookup + psum — one (rows, E) collective per step.
+
+The per-shard tile math reuses the Pallas kernels' own helpers
+(``_row_topk`` / ``_candidate_totals`` / ``_select_beams`` /
+``_masked_vocab`` / ``_gumbel_from_counter``) so tie order and the
+multinomial hash-Gumbel stream are IDENTICAL to the single-device
+kernels: sampler tokens (greedy AND multinomial) are bit-exact vs the
+``attlstm_sample_scan`` twin, and beam tokens are token-exact vs the
+scan path on the shared-harness inputs.  The one association daylight
+is the log-softmax normalizer: per-shard partial sums fold through a
+psum, a per-row constant shift at the last ulp (docs/PARITY.md r15).
+
+The monolithic whole-recurrence Pallas kernels remain the
+single-device fast path — a Pallas body cannot issue cross-shard
+collectives mid-recurrence — so under ``model_shards > 1`` the
+recurrence runs as a ``lax.scan`` in the shard_map body with the same
+decomposed GEMM order.  What the port buys is the collective layout:
+the forbidden per-step O(V) vocab gather becomes an O(shards·K)
+candidate merge, and every shard holds half (1/M) the vocab bytes
+(bench ``shard_fused_*`` rows measure both).
+
+Scope mirrors the kernels: single-layer attention or meanpool decoders
+from zero state, ``V % model_shards == 0`` and ``V/M >= K``
+(``shard_decode_ok``); ``model_from_config`` gates the flags through
+``decoding/core.py::DECODE_KERNEL_CAPS``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+from cst_captioning_tpu.decoding.core import NEG_INF
+from cst_captioning_tpu.ops.pallas_beam import (
+    _candidate_totals,
+    _row_topk,
+    _select_beams,
+)
+from cst_captioning_tpu.ops.pallas_lstm import _gate_update
+from cst_captioning_tpu.ops.pallas_sampler import (
+    _fmix32,
+    _gumbel_from_counter,
+    _masked_vocab,
+    _pick_tiles,
+)
+from cst_captioning_tpu.parallel.mesh import shard_map
+
+
+def shard_decode_ok(V: int, model_shards: int, K: int = 1) -> bool:
+    """Static gate for the shard_map decode port: the vocab must split
+    evenly over the model axis and each shard's tile must be able to
+    produce K candidates (the union argument needs per-shard top-K)."""
+    return (
+        model_shards > 1
+        and V % model_shards == 0
+        and V // model_shards >= max(K, 1)
+    )
+
+
+def _emb_psum(emb_loc, tok, col0, axis: str):
+    """Embedding rows for ``tok`` (R,) under a row-sharded (Vloc, E)
+    table: masked local lookup + psum over the model axis.  Exact — the
+    M-1 shards that don't own a row contribute 0.0."""
+    Vloc = emb_loc.shape[0]
+    local = tok - col0
+    valid = (local >= 0) & (local < Vloc)
+    rows = emb_loc[jnp.clip(local, 0, Vloc - 1)]
+    rows = jnp.where(valid[:, None], rows, jnp.zeros_like(rows))
+    return jax.lax.psum(rows, axis)
+
+
+def _attention_ctx(h, att_wh, proj_r, mask_r, vvec, vals_r, cdt):
+    """The kernels' per-step Bahdanau attention (same op order)."""
+    q = jax.lax.dot_general(
+        h.astype(cdt), att_wh,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    th = jnp.tanh(proj_r + q.astype(cdt)[:, None, :])
+    s = jnp.sum(th.astype(jnp.float32) * vvec[None, None, :], axis=-1)
+    s = jnp.where(mask_r > 0, s, NEG_INF)
+    m0 = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m0)
+    a = e / jnp.sum(e, axis=-1, keepdims=True)
+    return jnp.sum(a[:, :, None] * vals_r.astype(jnp.float32), axis=1)
+
+
+def _gates(gx_r, emb_tok, h, w_x, wh, w_ctx, ctx, cdt):
+    """Gate sum in the kernels' exact association order:
+    gxs + emb [+ ctx] + wh."""
+    gates = gx_r.astype(jnp.float32) + jax.lax.dot_general(
+        emb_tok.astype(cdt), w_x,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if ctx is not None:
+        gates = gates + jax.lax.dot_general(
+            ctx.astype(cdt), w_ctx,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+    return gates + jax.lax.dot_general(
+        h.astype(cdt), wh,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _local_logits(h_new, w_out_loc, bias_loc, cdt):
+    """This shard's (R, Vloc) logit tile, rounding through compute
+    dtype before the f32 cast exactly like ``CaptionModel._logits``."""
+    return (
+        jax.lax.dot_general(
+            h_new.astype(cdt), w_out_loc,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ).astype(cdt)
+        + bias_loc[None, :].astype(cdt)
+    ).astype(jnp.float32)
+
+
+# ------------------------------------------------------------------ beam
+
+def _sharded_beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
+                       mesh, axis, beam_size, max_len, suppress_unk):
+    """shard_map body + loop shared by both fusion modes.  ``att`` is
+    ``(w_ctx, att_wh, att_v, att_proj, att_mask, att_vals)`` or None
+    for the static-context (meanpool) variant — the ``_beam_impl``
+    calling convention."""
+    static_ctx = att is None
+    K = beam_size
+    B = gx_static.shape[0]
+    V = emb.shape[0]
+    M = mesh.shape[axis]
+    cdt = wh.dtype
+    T = max_len
+    R = B * K
+    bias, w_out_p = _masked_vocab(b_out, w_out, V, V, suppress_unk, cdt)
+
+    rep = lambda x: jnp.repeat(x, K, axis=0)  # noqa: E731
+    gx_r = rep(gx_static)
+    att_args, att_specs = (), ()
+    if not static_ctx:
+        w_ctx, att_wh, att_v, att_proj, att_mask, att_vals = att
+        att_args = (
+            w_ctx, att_wh, att_v.astype(jnp.float32)[:, 0],
+            rep(att_proj), rep(att_mask.astype(jnp.float32)),
+            rep(att_vals),
+        )
+        att_specs = (P(),) * 6
+
+    def body(gx_r, w_x, wh, bias_loc, emb_loc, w_out_loc, *att_local):
+        Vloc = w_out_loc.shape[-1]
+        shard = jax.lax.axis_index(axis)
+        col0 = shard * Vloc
+        gcol = col0 + jax.lax.broadcasted_iota(jnp.int32, (R, Vloc), 1)
+
+        def step(carry, t):
+            h, c, fin, score, seqs, tok = carry
+            emb_tok = _emb_psum(emb_loc, tok, col0, axis)
+            ctx = None
+            if not static_ctx:
+                w_ctx, att_wh, vvec, proj_r, mask_r, vals_r = att_local
+                ctx = _attention_ctx(
+                    h, att_wh, proj_r, mask_r, vvec, vals_r, cdt
+                )
+            gates = _gates(
+                gx_r, emb_tok, h, w_x, wh,
+                None if static_ctx else att_local[0], ctx, cdt,
+            )
+            h_new, c_new = _gate_update(gates, c)
+
+            logit = _local_logits(h_new, w_out_loc, bias_loc, cdt)
+            # Exact global max; normalizer folds per-shard partials
+            # through one psum (the PARITY r15 association note).
+            m = jax.lax.pmax(
+                jnp.max(logit, axis=-1, keepdims=True), axis
+            )
+            ssum = jax.lax.psum(
+                jnp.sum(jnp.exp(logit - m), axis=-1, keepdims=True),
+                axis,
+            )
+            # Per-shard top-K candidates with GLOBAL vocab ids (the
+            # kernels' (value desc, id asc) tie order), then the
+            # O(shards*K) candidate all-gather + union re-top-K —
+            # exactly the global per-row top-K.
+            tv, ti = _row_topk(logit, gcol, K)
+            top_v = jnp.moveaxis(
+                jax.lax.all_gather(tv, axis), 0, 1
+            ).reshape(R, M * K)
+            top_i = jnp.moveaxis(
+                jax.lax.all_gather(ti, axis), 0, 1
+            ).reshape(R, M * K)
+            top_v, top_i = _row_topk(top_v, top_i, K)
+
+            totals, keys = _candidate_totals(
+                top_v, top_i, m, ssum, score, fin, K, V
+            )
+            sc, parent, tok_sel = _select_beams(
+                totals.reshape(B, K * K), keys.reshape(B, K * K), K, V
+            )
+
+            batch_ix = jnp.arange(B)[:, None]
+            seqs = seqs[batch_ix, parent]
+            seqs = jax.lax.dynamic_update_index_in_dim(
+                seqs, tok_sel, t, axis=2
+            )
+            fin2 = fin.reshape(B, K)[batch_ix, parent]
+            ended = (tok_sel == EOS_ID) | (tok_sel == PAD_ID)
+            fin_new = jnp.maximum(fin2, ended.astype(jnp.float32))
+            flat_parent = (batch_ix * K + parent).reshape(-1)
+            feed = jnp.where(
+                tok_sel == PAD_ID, EOS_ID, tok_sel
+            ).reshape(-1)
+            return (
+                h_new[flat_parent], c_new[flat_parent],
+                fin_new.reshape(R, 1), sc.reshape(R, 1), seqs, feed,
+            ), None
+
+        zeros = jnp.zeros((R, wh.shape[0]), jnp.float32)
+        beam = jnp.arange(R, dtype=jnp.int32)[:, None] % K
+        score0 = jnp.where(beam == 0, 0.0, jnp.float32(NEG_INF))
+        carry0 = (
+            zeros, zeros, jnp.zeros((R, 1), jnp.float32), score0,
+            jnp.full((B, K, T), PAD_ID, jnp.int32),
+            jnp.full((R,), BOS_ID, jnp.int32),
+        )
+        (_, _, _, score, seqs, _), _ = jax.lax.scan(
+            step, carry0, jnp.arange(T, dtype=jnp.int32)
+        )
+        return seqs, score.reshape(B, K)
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(
+            P(), P(), P(),            # gx_r, w_x, wh (replicated)
+            P(axis),                  # bias columns
+            P(axis, None),            # embedding rows
+            P(None, axis),            # w_out columns
+            *att_specs,
+        ),
+        out_specs=(P(), P()),
+        check_rep=False,  # outputs replicated by construction (merged)
+    )(gx_r, w_x, wh, bias, emb, w_out_p, *att_args)
+
+
+def sharded_attlstm_beam(
+    gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
+    att_vals, emb, w_out, b_out,
+    *, mesh, axis: str = "model", beam_size: int, max_len: int,
+    suppress_unk: bool = False,
+):
+    """Sharded fused beam search (attention fusion) — the shard_map
+    port of :func:`ops.pallas_beam.attlstm_beam`, same argument and
+    ``(seqs (B, K, L), scores (B, K))`` return contract; feed both to
+    ``decoding.beam.finalize_beams``."""
+    return _sharded_beam_impl(
+        gx_static, w_x, wh,
+        (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
+        emb, w_out, b_out, mesh, axis, beam_size, max_len, suppress_unk,
+    )
+
+
+def sharded_lstm_beam(
+    gx_static, w_x, wh, emb, w_out, b_out,
+    *, mesh, axis: str = "model", beam_size: int, max_len: int,
+    suppress_unk: bool = False,
+):
+    """Static-context (meanpool) sharded fused beam search — the
+    shard_map port of :func:`ops.pallas_beam.lstm_beam`."""
+    return _sharded_beam_impl(
+        gx_static, w_x, wh, None, emb, w_out, b_out,
+        mesh, axis, beam_size, max_len, suppress_unk,
+    )
+
+
+# --------------------------------------------------------------- sampler
+
+def _sharded_sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
+                         seed, mesh, axis, max_len, greedy, temperature,
+                         suppress_unk):
+    """Sharded fused sampling: per-shard Gumbel-max (or argmax)
+    candidates merged by (z desc, global id asc).  The hash-Gumbel
+    counters use GLOBAL vocab positions and the kernel's padded-width
+    arithmetic (via the same ``_pick_tiles``), so the multinomial
+    stream is bit-identical to the single-device kernel and its
+    ``attlstm_sample_scan`` twin — sharding cannot move a draw."""
+    static_ctx = att is None
+    B = gx_static.shape[0]
+    H = wh.shape[0]
+    E = w_x.shape[0]
+    if static_ctx:
+        F = A = 0
+    else:
+        F, A = att[3].shape[1], att[3].shape[2]
+    V = emb.shape[0]
+    cdt = wh.dtype
+    T = max_len
+    bt, Vt = _pick_tiles(B, F, A, E, H, jnp.dtype(cdt).itemsize)
+    V_pad = -(-V // Vt) * Vt   # counter arithmetic only — no padding
+    bias, w_out_p = _masked_vocab(b_out, w_out, V, V, suppress_unk, cdt)
+
+    seed_arr = jnp.asarray(seed, jnp.int32).reshape(-1)
+    if seed_arr.shape[0] < 2:
+        seed_arr = jnp.concatenate(
+            [seed_arr, jnp.zeros((2 - seed_arr.shape[0],), jnp.int32)]
+        )
+    rows = jnp.arange(B, dtype=jnp.int32)
+    seed_words = _fmix32(
+        _fmix32(
+            seed_arr[0].astype(jnp.uint32)
+            + jnp.uint32(0x9E3779B9) * ((rows // bt) * bt).astype(jnp.uint32)
+        )
+        + seed_arr[1].astype(jnp.uint32)
+    )
+    inv_temp = (
+        jnp.float32(1.0) if greedy
+        else jnp.float32(1.0) / jnp.asarray(temperature, jnp.float32)
+    )
+    att_args, att_specs = (), ()
+    if not static_ctx:
+        w_ctx, att_wh, att_v, att_proj, att_mask, att_vals = att
+        att_args = (
+            w_ctx, att_wh, att_v.astype(jnp.float32)[:, 0],
+            att_proj, att_mask.astype(jnp.float32), att_vals,
+        )
+        att_specs = (P(),) * 6
+
+    def body(gx, w_x, wh, bias_loc, emb_loc, w_out_loc, seed_words,
+             inv_temp, *att_local):
+        Vloc = w_out_loc.shape[-1]
+        shard = jax.lax.axis_index(axis)
+        col0 = shard * Vloc
+        gcol = col0 + jax.lax.broadcasted_iota(jnp.int32, (B, Vloc), 1)
+
+        def step(carry, t):
+            h, c, fin, tok = carry
+            emb_tok = _emb_psum(emb_loc, tok, col0, axis)
+            ctx = None
+            if not static_ctx:
+                w_ctx, att_wh, vvec, proj_r, mask_r, vals_r = att_local
+                ctx = _attention_ctx(
+                    h, att_wh, proj_r, mask_r, vvec, vals_r, cdt
+                )
+            gates = _gates(
+                gx, emb_tok, h, w_x, wh,
+                None if static_ctx else att_local[0], ctx, cdt,
+            )
+            h_new, c_new = _gate_update(gates, c)
+
+            logit = _local_logits(h_new, w_out_loc, bias_loc, cdt)
+            scaled = logit * inv_temp
+            if greedy:
+                z = scaled
+            else:
+                counter = (
+                    (rows * T + t).astype(jnp.uint32)[:, None]
+                    * jnp.uint32(V_pad)
+                    + gcol.astype(jnp.uint32)
+                )
+                z = scaled + _gumbel_from_counter(
+                    counter, seed_words[:, None]
+                )
+            # Per-shard winner triple, merged by (z desc, id asc) —
+            # the kernel's ascending-tile / lowest-id tie behavior.
+            loc_arg = jnp.argmax(z, axis=-1)
+            loc_z = jnp.take_along_axis(z, loc_arg[:, None], -1)[:, 0]
+            loc_sc = jnp.take_along_axis(
+                scaled, loc_arg[:, None], -1
+            )[:, 0]
+            gid = col0 + loc_arg.astype(jnp.int32)
+            zs = jnp.moveaxis(jax.lax.all_gather(loc_z, axis), 0, 1)
+            ids = jnp.moveaxis(jax.lax.all_gather(gid, axis), 0, 1)
+            scs = jnp.moveaxis(jax.lax.all_gather(loc_sc, axis), 0, 1)
+            order = jnp.lexsort((ids, -zs), axis=-1)[:, :1]
+            b_ix = jnp.arange(B)[:, None]
+            nxt = ids[b_ix, order][:, 0]
+            chosen = scs[b_ix, order][:, 0]
+            # Global LSE of the scaled logits (psum association).
+            m = jax.lax.pmax(
+                jnp.max(scaled, axis=-1, keepdims=True), axis
+            )
+            ssum = jax.lax.psum(
+                jnp.sum(jnp.exp(scaled - m), axis=-1, keepdims=True),
+                axis,
+            )
+            lse = (m + jnp.log(ssum))[:, 0]
+            tok_lp = chosen - lse
+            valid = ~fin
+            out_tok = jnp.where(valid, nxt, PAD_ID)
+            out_lp = jnp.where(valid, tok_lp, 0.0)
+            ended = (nxt == EOS_ID) | (nxt == PAD_ID)
+            fin = fin | ended
+            feed = jnp.where(out_tok == PAD_ID, EOS_ID, out_tok)
+            return (h_new, c_new, fin, feed), (
+                out_tok, out_lp, valid.astype(jnp.float32)
+            )
+
+        zeros = jnp.zeros((B, H), jnp.float32)
+        bos = jnp.full((B,), BOS_ID, jnp.int32)
+        fin0 = jnp.zeros((B,), bool)
+        _, (toks, lps, msk) = jax.lax.scan(
+            step, (zeros, zeros, fin0, bos),
+            jnp.arange(T, dtype=jnp.int32),
+        )
+        return (
+            jnp.swapaxes(toks, 0, 1),
+            jnp.swapaxes(lps, 0, 1),
+            jnp.swapaxes(msk, 0, 1),
+        )
+
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(
+            P(), P(), P(),            # gx_static, w_x, wh
+            P(axis),                  # bias columns
+            P(axis, None),            # embedding rows
+            P(None, axis),            # w_out columns
+            P(), P(),                 # seed words, inv_temp
+            *att_specs,
+        ),
+        out_specs=(P(), P(), P()),
+        check_rep=False,
+    )(gx_static, w_x, wh, bias, emb, w_out_p, seed_words, inv_temp,
+      *att_args)
+
+
+def sharded_attlstm_sample(
+    gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
+    att_vals, emb, w_out, b_out, seed,
+    *, mesh, axis: str = "model", max_len: int, greedy: bool,
+    temperature: float = 1.0, suppress_unk: bool = False,
+):
+    """Sharded fused sample (attention fusion) — the shard_map port of
+    :func:`ops.pallas_sampler.attlstm_sample`, same argument and
+    ``(tokens, logprobs, mask)`` return contract."""
+    return _sharded_sample_impl(
+        gx_static, w_x, wh,
+        (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
+        emb, w_out, b_out, seed, mesh, axis, max_len, greedy,
+        temperature, suppress_unk,
+    )
+
+
+def sharded_lstm_sample(
+    gx_static, w_x, wh, emb, w_out, b_out, seed,
+    *, mesh, axis: str = "model", max_len: int, greedy: bool,
+    temperature: float = 1.0, suppress_unk: bool = False,
+):
+    """Static-context (meanpool) sharded fused sample — the shard_map
+    port of :func:`ops.pallas_sampler.lstm_sample`."""
+    return _sharded_sample_impl(
+        gx_static, w_x, wh, None, emb, w_out, b_out, seed,
+        mesh, axis, max_len, greedy, temperature, suppress_unk,
+    )
+
+
+# ------------------------------------------------ parity-harness backends
+
+def _tp_mesh(model_shards: int = 2):
+    """A (data=1, model=M) mesh over the first M local devices, or None
+    when the host doesn't have them (the runner then degrades to its
+    reference — the bench probe controls backend init, so no device
+    counting happens at import)."""
+    if len(jax.devices()) < model_shards:
+        return None
+    from cst_captioning_tpu.parallel import make_mesh
+
+    return make_mesh(
+        {"data": 1, "model": model_shards},
+        devices=jax.devices()[:model_shards],
+    )
+
+
+def _sharded_beam_runner(ctx):
+    """Registry runner: the sharded fused beam under model_shards=2,
+    through the same ``beam_search`` dispatch as every other beam
+    backend — the model carries ``decode_mesh`` and rule-table-sharded
+    params, so the run exercises the REAL serving dispatch."""
+    from cst_captioning_tpu.decoding.beam import beam_search
+    from cst_captioning_tpu.decoding.core import get_backend
+    from cst_captioning_tpu.parallel import shard_params
+
+    mesh = _tp_mesh(2)
+    if mesh is None:  # pragma: no cover — tier-1 runs 8 virtual devices
+        return get_backend("scan_beam").run(ctx)
+    r = beam_search(
+        ctx.make_model(use_pallas_beam=True, decode_mesh=mesh),
+        shard_params(ctx.params, mesh), ctx.feats, ctx.masks,
+        category=ctx.category, beam_size=ctx.beam_size,
+        max_len=ctx.max_len,
+    )
+    return {
+        "tokens": np.asarray(r.all_tokens[:, 0]),
+        "scores": np.asarray(r.all_scores[:, 0]),
+        "all_tokens": np.asarray(r.all_tokens),
+    }
+
+
+def _sharded_sampler_runner(ctx):
+    """Registry runner: the sharded fused sampler (greedy — the
+    deterministic surface, like the ``fused_sampler`` backend) under
+    model_shards=2."""
+    from cst_captioning_tpu.decoding.core import get_backend
+    from cst_captioning_tpu.parallel import shard_params
+
+    mesh = _tp_mesh(2)
+    if mesh is None:  # pragma: no cover — tier-1 runs 8 virtual devices
+        return get_backend("scan_greedy").run(ctx)
+    out = ctx.make_model(
+        use_pallas_sampler=True, decode_mesh=mesh
+    ).apply(
+        shard_params(ctx.params, mesh), ctx.feats, ctx.masks,
+        category=ctx.category, max_len=ctx.max_len, greedy=True,
+        method="sample",
+    )
+    return {
+        "tokens": np.asarray(out.tokens),
+        "lps": np.asarray(out.logprobs),
+        "mask": np.asarray(out.mask),
+    }
+
+
+from cst_captioning_tpu.decoding.core import register_backend  # noqa: E402
+
+register_backend(
+    "fused_beam_tp2", _sharded_beam_runner, kind="beam", ref="scan_beam"
+)
+register_backend(
+    "fused_sampler_tp2", _sharded_sampler_runner, kind="greedy",
+    ref="scan_greedy",
+)

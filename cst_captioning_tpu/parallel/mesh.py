@@ -119,3 +119,25 @@ def make_mesh(
 
 def mesh_from_config(cfg, devices: Optional[Sequence] = None) -> Mesh:
     return make_mesh(dict(cfg.train.mesh_shape), devices)
+
+
+def submesh_groups(devices: Sequence, group_size: int) -> list:
+    """Deterministic per-replica device groups for (R, M) serving
+    grids: id-sort (the same (process, id) key :func:`make_mesh`
+    uses), then contiguous ``group_size``-device slices — replica i
+    always gets devices [i·M, (i+1)·M), so the fleet layout is a pure
+    function of config + enumeration, and on real hardware contiguous
+    groups ride adjacent ICI links for the cross-shard candidate
+    merge (ISSUE 14)."""
+    if group_size < 1:
+        raise ValueError(f"submesh group size {group_size} < 1")
+    devs = sorted(
+        devices,
+        key=lambda d: (
+            getattr(d, "process_index", 0), getattr(d, "id", 0)
+        ),
+    )
+    return [
+        devs[i:i + group_size]
+        for i in range(0, len(devs) - group_size + 1, group_size)
+    ]

@@ -205,6 +205,28 @@ def logits_sharding(
     return NamedSharding(mesh, logits_spec(mesh, ndim))
 
 
+def rows_sharding(
+    mesh: Mesh, shape: Tuple[int, ...], row_axis: int = 0
+) -> NamedSharding:
+    """Activation sharding for slot/row-major serving state: the
+    ``row_axis`` dim shards over ``data`` when the mesh carries
+    data > 1 AND the dim divides it; every other case — including the
+    whole (data=1, model=N) submesh family — is replication.  THE one
+    spec site for slot-state placement (serving/slots.py::
+    SlotDecoder._slot_shardings), so the ISSUE-14 activation-sharding
+    rule lives beside the param rule table it extends."""
+    data = int(mesh.shape.get("data", 1))
+    if (
+        data > 1
+        and len(shape) > row_axis
+        and shape[row_axis] % data == 0
+    ):
+        spec = [None] * len(shape)
+        spec[row_axis] = "data"
+        return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
 def constrain(x, sharding: Optional[NamedSharding]):
     """``with_sharding_constraint`` that degrades to identity off-mesh —
     the one helper every activation-boundary pin routes through, so the

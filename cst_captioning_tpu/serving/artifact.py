@@ -35,6 +35,17 @@ content hash over (fingerprint, environment, per-variant HLO keys), so
 rebuilding an unchanged engine is a no-op and two hosts building the
 same deploy agree on the version string.
 
+**Sharded executables (ISSUE 14).**  A model-sharded engine's tick
+variants contain the shard_map candidate-merge collectives and are
+compiled against its (1, M) submesh, so the executables only make
+sense on the same topology.  The manifest already carries the gate:
+``fingerprint.mesh_shape`` ("1x2"-style) participates in the content
+hash AND in the field-by-field load validation, so a sharded artifact
+refuses to boot a differently-sharded (or unsharded) engine with a
+named mismatch instead of deserializing collectives onto the wrong
+device set — the same refusal-not-adaptation contract as every other
+manifest field (docs/PARITY.md r14).
+
 **Load** (:func:`load_engine`, ``InferenceEngine.from_artifact``): the
 manifest is validated FIELD BY FIELD against the live environment —
 any mismatch raises :class:`ArtifactMismatchError` naming every

@@ -98,6 +98,7 @@ class InferenceEngine:
         params_version: str = "0",
         random_init: bool = False,
         mesh=None,
+        devices=None,
     ):
         self.cfg = cfg
         sv = cfg.serving
@@ -106,11 +107,16 @@ class InferenceEngine:
             cfg.model.vocab_size = len(self.vocab)
         # Model-sharded engine (serving.model_shards > 1): ONE logical
         # replica spans a (data=1, model=N) mesh — vocab-sized params
-        # shard per parallel/partition.py, decode-step logits carry a
-        # model-axis constraint in the slot loop, slot/decode state is
-        # replicated across the shard group (data axis is 1, so
-        # data-sharding degenerates to replication).  model_shards == 1
-        # leaves every code path byte-identical to the pre-TP engine.
+        # shard per parallel/partition.py, the slot decode's per-step
+        # top-K merges per-shard candidates across the model axis
+        # (serving.shard_fused_decode), slot/decode state is replicated
+        # across the shard group (data axis is 1).  Composes with
+        # `serving.replicas` into an (R, M) grid: ReplicaSet.from_engine
+        # clones this engine onto deterministic per-replica submeshes
+        # of M id-sorted devices each; `devices` pins THIS engine's
+        # shard group (clone_for_submesh), defaulting to the first M
+        # local devices.  model_shards == 1 leaves every code path
+        # byte-identical to the pre-TP engine.
         self.tp_mesh = None
         model_shards = int(getattr(sv, "model_shards", 1) or 1)
         if model_shards > 1:
@@ -119,19 +125,28 @@ class InferenceEngine:
                     "pass either an explicit mesh or "
                     "serving.model_shards > 1, not both"
                 )
-            if sv.replicas != 1:
-                raise ValueError(
-                    f"serving.model_shards={model_shards} spans devices "
-                    f"itself — it requires replicas=1 (got "
-                    f"{sv.replicas}); replica x shard grids are a "
-                    "multi-host concern (ROADMAP)"
-                )
-            devs = jax.devices()
+            devs = list(devices) if devices is not None else jax.devices()
             if len(devs) < model_shards:
                 raise ValueError(
                     f"serving.model_shards={model_shards} needs that "
                     f"many devices, have {len(devs)}"
                 )
+            # (R, M) grid validation happens HERE, at the first engine,
+            # so a mis-sized grid fails at boot, not at clone time —
+            # clones (explicit `devices`) were validated by their
+            # parent and see only their own M-device submesh.
+            if devices is None:
+                n_rep = int(sv.replicas)
+                if n_rep == 0:
+                    n_rep = max(1, len(devs) // model_shards)
+                if n_rep < 0 or n_rep * model_shards > len(devs):
+                    raise ValueError(
+                        f"serving grid replicas={sv.replicas} x "
+                        f"model_shards={model_shards} needs "
+                        f"{max(n_rep, 0) * model_shards} local "
+                        f"devices, have {len(devs)} — shrink an axis "
+                        "(replicas*model_shards must fit the host)"
+                    )
             from cst_captioning_tpu.parallel import make_mesh
 
             self.tp_mesh = make_mesh(
@@ -139,6 +154,12 @@ class InferenceEngine:
                 devices=devs[:model_shards],
             )
             mesh = self.tp_mesh
+        elif mesh is not None and mesh.devices.size > 1:
+            # Explicit multi-device serving mesh (tests / embedders):
+            # the slot decoder reads tp_mesh for state placement — a
+            # mesh that carries data > 1 activation-shards the slot
+            # rows over it (serving/slots.py::_init_state).
+            self.tp_mesh = mesh
         self.model: CaptionModel = model_from_config(cfg, mesh=mesh)
         if params is None:
             if checkpoint:
@@ -767,7 +788,8 @@ class InferenceEngine:
             raise ValueError(
                 "a model-sharded engine (serving.model_shards > 1) spans "
                 "its device group and cannot be cloned per-device — "
-                "replica scaling requires model_shards=1"
+                "replica scaling of sharded engines goes through "
+                "clone_for_submesh (one (1, M) submesh per replica)"
             )
         # Warm AFTER the replica identity lands: the slot decoder reads
         # ``engine.device`` (slot-matrix placement) and
@@ -789,6 +811,56 @@ class InferenceEngine:
         # engine's did — the fleet-diagnosis question).
         eng.artifact_version = self.artifact_version
         eng.device = device
+        eng.replica_id = replica_id
+        if warm:
+            eng.warmup()
+        return eng
+
+    def clone_for_submesh(self, devices, replica_id: Optional[int] = None):
+        """A model-sharded replica of this engine on its own (1, M)
+        submesh — the tensor-parallel twin of :meth:`clone_for_device`
+        and the unit the (R replicas) x (M shards) serving grid is
+        built from (``ReplicaSet.from_engine``).  ``devices`` must be
+        exactly this engine's shard count; weights are gathered to host
+        once and committed to the new submesh by the same rule table,
+        so — like ``clone_for_device`` — placement copies bytes and
+        cannot change any decoded token.  The clone shares the two-tier
+        cache and ``params_tag``; with ``serving.warmup`` it pre-jits
+        its ladder and slot loop after the replica identity lands."""
+        import copy
+
+        if self.tp_mesh is None or self.tp_mesh.shape.get("model", 1) < 2:
+            raise ValueError(
+                "clone_for_submesh needs a model-sharded engine "
+                "(serving.model_shards > 1) — use clone_for_device for "
+                "single-device replicas"
+            )
+        M = self.tp_mesh.shape["model"]
+        devices = list(devices)
+        if len(devices) != M:
+            raise ValueError(
+                f"clone_for_submesh got {len(devices)} devices for a "
+                f"{M}-way model-sharded engine — each replica submesh "
+                "must hold exactly model_shards devices"
+            )
+        cfg2 = copy.deepcopy(self.cfg)
+        warm = cfg2.serving.warmup
+        cfg2.serving.warmup = False
+        # Gather once to host, then the ctor re-commits by the rule
+        # table onto the new submesh (a layout move, never arithmetic).
+        host_params = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x)), self.params
+        )
+        eng = InferenceEngine(
+            cfg2,
+            params=host_params,
+            vocab=self.vocab,
+            cache=self.cache,
+            devices=devices,
+        )
+        eng.cfg.serving.warmup = warm
+        eng.params_tag = self.params_tag
+        eng.artifact_version = self.artifact_version
         eng.replica_id = replica_id
         if warm:
             eng.warmup()

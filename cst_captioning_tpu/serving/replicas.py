@@ -242,12 +242,41 @@ class ReplicaSet(_BatcherBase):
         (``serving.replicas``; 0 = one per device).  More replicas than
         devices wrap round-robin onto the same devices (useful on a
         single-device host: the workers still overlap their host-side
-        work)."""
+        work).
+
+        A model-sharded engine (``serving.model_shards = M > 1``) turns
+        this into the (R, M) serving GRID: devices are id-sorted (the
+        ``make_mesh`` determinism contract) and partitioned into R
+        contiguous groups of M — replica i always lands on devices
+        [i*M, (i+1)*M), so the fleet layout is a pure function of the
+        config and the device enumeration — and each replica is a
+        ``clone_for_submesh`` engine on its own (1, M) mesh.  0 = one
+        sharded replica per M devices; R*M must fit the device count
+        (validated here and at engine boot, message-pinned)."""
         import jax
 
         sv = engine.cfg.serving
         n = sv.replicas if n_replicas is None else n_replicas
         devs = list(devices if devices is not None else jax.devices())
+        tp = getattr(engine, "tp_mesh", None)
+        M = tp.shape.get("model", 1) if tp is not None else 1
+        if M > 1:
+            from cst_captioning_tpu.parallel.mesh import submesh_groups
+
+            groups = submesh_groups(devs, M)
+            if n <= 0:
+                n = len(groups)
+            if n < 1 or n > len(groups):
+                raise ValueError(
+                    f"serving grid replicas={n} x model_shards={M} "
+                    f"needs {max(n, 0) * M} local devices, have "
+                    f"{len(devs)} — shrink an axis"
+                )
+            engines = [
+                engine.clone_for_submesh(groups[i], replica_id=i)
+                for i in range(n)
+            ]
+            return cls(engines, metrics, **kw)
         if n <= 0:
             n = len(devs)
         engines = [

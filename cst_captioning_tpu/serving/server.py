@@ -383,6 +383,19 @@ class CaptionServer:
 
             def _scale_up_engine():
                 rid = len(self.batcher.replicas)
+                tp = getattr(engine, "tp_mesh", None)
+                M = tp.shape.get("model", 1) if tp is not None else 1
+                if M > 1:
+                    # Sharded fleet: wrap round-robin over the same
+                    # contiguous M-device groups from_engine assigns.
+                    from cst_captioning_tpu.parallel.mesh import (
+                        submesh_groups,
+                    )
+
+                    groups = submesh_groups(devs, M)
+                    return engine.clone_for_submesh(
+                        groups[rid % len(groups)], replica_id=rid
+                    )
                 return engine.clone_for_device(
                     devs[rid % len(devs)], replica_id=rid
                 )

@@ -233,10 +233,19 @@ class SlotDecoder:
         if getattr(self.model, "use_pallas_beam", False):
             # The fused whole-recurrence kernel decodes run-to-completion
             # by construction; the slot loop needs step granularity.
+            # Whether the slot step itself gets the tensor-parallel fast
+            # path is a CAPABILITY question, not a hardcoded refusal
+            # (decoding/core.py::DECODE_KERNEL_CAPS, ISSUE 14).
+            from cst_captioning_tpu.decoding.core import kernel_supports
+
+            shards = int(getattr(self.model, "decode_shards", 1) or 1)
             _log.info(
-                "continuous slot loop uses the per-step scan math; the "
-                "fused beam kernel (use_pallas_beam) applies to the "
-                "ladder/offline paths only"
+                "continuous slot loop uses the per-step decode core; %s",
+                "the cross-shard fused top-K merge covers the "
+                "model-sharded step (shard_fused_decode)"
+                if shards > 1 and kernel_supports("use_pallas_beam", "model")
+                else "the fused beam kernel (use_pallas_beam) applies "
+                "to the ladder/offline paths only",
             )
         # Host-side slot bookkeeping (scheduler thread only).  ``free``
         # stays SORTED and admission takes the LOWEST index, so high
@@ -339,19 +348,45 @@ class SlotDecoder:
         dev = getattr(self.engine, "device", None)
         if dev is not None:
             return jax.device_put(st, dev)
-        # Model-sharded engines: slot state is activation-shaped, so it
-        # carries the data-axis sharding — which on the (data=1,
-        # model=N) serving mesh degenerates to replication across the
-        # shard group.  Committing it explicitly keeps the first tick
-        # from running single-device against mesh-sharded params.
+        # Mesh-carrying engines: slot state is activation-shaped, so it
+        # commits with the data-axis sharding on its slot/row axes —
+        # which on the (data=1, model=N) serving submesh degenerates to
+        # replication across the shard group, and on a serving mesh
+        # that carries data > 1 actually shards the slot rows (ISSUE
+        # 14: activation-sharded slot state).  Committing it explicitly
+        # keeps the first tick from running single-device against
+        # mesh-sharded params.
         tp = getattr(self.engine, "tp_mesh", None)
         if tp is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            return jax.device_put(
-                st, NamedSharding(tp, PartitionSpec())
-            )
+            return jax.device_put(st, self._slot_shardings(st, tp))
         return st
+
+    def _slot_shardings(self, st: SlotState, mesh):
+        """Per-leaf NamedShardings for the slot-state pytree on a
+        serving mesh: the slot/row axis (axis 1 for the (layers, rows,
+        H) LSTM carry, axis 0 everywhere else) shards over ``data``
+        when the mesh carries data > 1 AND the axis divides it; every
+        other case — including the whole (data=1, model=N) submesh
+        grid — is replication, byte-identical to the PR-9 layout.
+        The spec rule itself lives beside the param rule table
+        (parallel/partition.py::rows_sharding)."""
+        from jax.sharding import NamedSharding
+
+        from cst_captioning_tpu.parallel.partition import rows_sharding
+
+        carry = jax.tree.map(
+            lambda x: rows_sharding(mesh, x.shape, 1), st.core.state
+        )
+        core = st.core._replace(state=carry)
+        core = jax.tree.map(
+            lambda x: x if isinstance(x, NamedSharding)
+            else rows_sharding(mesh, x.shape, 0),
+            core,
+        )
+        cache = jax.tree.map(
+            lambda x: rows_sharding(mesh, x.shape, 0), st.cache
+        )
+        return SlotState(core=core, cache=cache)
 
     def _build_step(self) -> None:
         model, K, dedup = self.model, self.K, self.dedup
@@ -360,13 +395,43 @@ class SlotDecoder:
         # vocab-over-model so XLA keeps the logit matmul sharded through
         # the step instead of all-gathering before the top-K/argmax —
         # the serving twin of the training-side logits constraint
-        # (parallel/partition.py::logits_spec, docs/PERF.md r12).
+        # (parallel/partition.py::logits_spec, docs/PERF.md r12) — and,
+        # with ``serving.shard_fused_decode`` (default), swap the
+        # inline top-K/argmax for the cross-shard candidate merge
+        # (decoding/core.py::make_tp_beam_topk / make_tp_row_pick):
+        # each shard top-Ks its own vocab tile and one O(shards*K)
+        # candidate all-gather replaces the O(V) full-vocab gather the
+        # SPMD partitioner otherwise inserts on the hottest serving op
+        # (docs/PERF.md r14; token-exact incl. tie order, PARITY r15,
+        # pinned by the *_tp2_fused backends in the shared harness).
         tp_logits = None
+        tp_topk = tp_pick = None
         tp = getattr(self.engine, "tp_mesh", None)
         if tp is not None and tp.shape.get("model", 1) > 1:
             from cst_captioning_tpu.parallel import partition
 
             tp_logits = partition.logits_sharding(tp, ndim=2)
+            M = tp.shape["model"]
+            sv = self.engine.cfg.serving
+            if bool(getattr(sv, "shard_fused_decode", True)):
+                if self.V % M == 0:
+                    from cst_captioning_tpu.decoding.core import (
+                        make_tp_beam_topk,
+                        make_tp_row_pick,
+                    )
+
+                    if self.greedy:
+                        tp_pick = make_tp_row_pick(tp)
+                    else:
+                        tp_topk = make_tp_beam_topk(tp)
+                else:
+                    _log.warning(
+                        "serving.shard_fused_decode requested but vocab "
+                        "%d does not tile over the %d-way model axis — "
+                        "keeping the full-vocab-gather top-K (pad the "
+                        "vocab to a multiple of model_shards)",
+                        self.V, M,
+                    )
 
         def step_once(params, st: SlotState) -> SlotState:
             # The per-step recurrence is the unified decode core
@@ -393,7 +458,10 @@ class SlotDecoder:
                     )
                 return new_state, logits
 
-            core = decode_step(step_logits, st.core, mode=mode)
+            core = decode_step(
+                step_logits, st.core, mode=mode,
+                topk_fn=tp_topk, pick_fn=tp_pick,
+            )
             return SlotState(core=core, cache=st.cache)
 
         self._step_once = step_once
@@ -1132,6 +1200,7 @@ class _ParityEngine:
     def __init__(
         self, ctx, *, mode: str, num_slots: int, block: int,
         dedup: bool = True, bank_min: int = 0, model_shards: int = 1,
+        shard_fused: bool = True,
     ):
         from types import SimpleNamespace
 
@@ -1171,6 +1240,7 @@ class _ParityEngine:
                 num_slots=num_slots, slot_block_steps=block,
                 dedup_cache=dedup, slot_bank_min=bank_min,
                 slot_shrink_idle_ticks=4, zero_freed_slots=True,
+                shard_fused_decode=shard_fused,
             ),
             eval=SimpleNamespace(
                 beam_size=ctx.beam_size, max_decode_len=ctx.max_len,
@@ -1200,20 +1270,24 @@ class _ParityEngine:
 
 
 def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0,
-                 model_shards: int = 1, aot: bool = False):
+                 model_shards: int = 1, aot: bool = False,
+                 shard_fused: bool = True):
     """Decode every ctx row through a small slot matrix with staggered
     admissions (slots hold rows at different decode depths), then map
     harvests back to row order.  ``dedup`` selects the per-slot vs the
     legacy replicated cache layout; ``bank_min`` > 0 exercises the
     elastic bank ladder mid-traffic; ``model_shards`` > 1 runs the
-    model-sharded (data=1, model=N) engine layout; ``aot`` runs the
-    artifact boot path — every variant ``.lower().compile()``d by a
-    builder decoder and installed into a FRESH decoder that must build
-    zero variants of its own (``compile_count == 0``, the PR-13 pin)."""
+    model-sharded (data=1, model=N) engine layout (``shard_fused``
+    selects the cross-shard fused candidate merge vs the PR-9
+    full-vocab-gather top-K); ``aot`` runs the artifact boot path —
+    every variant ``.lower().compile()``d by a builder decoder and
+    installed into a FRESH decoder that must build zero variants of
+    its own (``compile_count == 0``, the PR-13 pin)."""
     B = next(iter(ctx.feats.values())).shape[0]
     eng = _ParityEngine(
         ctx, mode=mode, num_slots=max(2, B // 2), block=1,
         dedup=dedup, bank_min=bank_min, model_shards=model_shards,
+        shard_fused=shard_fused,
     )
     dec = SlotDecoder(eng)
     if aot:
@@ -1300,13 +1374,37 @@ register_backend(
 # logits over a 2-way model axis; the column-sharded logit matmul keeps
 # every column's reduction order, so tokens AND scores must match the
 # replicated layout exactly (the docs/PARITY.md r12 serving contract).
+# shard_fused=False pins the PR-9 full-vocab-gather top-K path; the
+# *_tp2_fused twins below pin the ISSUE-14 cross-shard candidate merge
+# against the same scan reference — both through the one harness.
 # On a 1-device host the _ParityEngine degrades to the replicated
 # layout with a log line (device counting at import would force backend
 # init, which the bench probe must control) — tier-1's virtual 8-CPU
 # platform always runs the real sharded path.
 register_backend(
     "slot_decoder_beam_tp2",
-    lambda ctx: _slot_runner(ctx, "beam", model_shards=2),
+    lambda ctx: _slot_runner(ctx, "beam", model_shards=2,
+                             shard_fused=False),
     kind="beam",
     ref="scan_beam",
+)
+# Cross-shard FUSED top-K merge (ISSUE 14): per-shard vocab-tile top-K
+# + O(shards*K) candidate all-gather instead of the O(V) gather —
+# token-exact vs the scan path including tie order at the vocab-tile
+# shard boundary (decoding/core.py::make_tp_beam_topk; PARITY r15).
+register_backend(
+    "slot_decoder_beam_tp2_fused",
+    lambda ctx: _slot_runner(ctx, "beam", model_shards=2,
+                             shard_fused=True),
+    kind="beam",
+    ref="scan_beam",
+)
+# The sampler-side twin: the slot loop's greedy mode under the same
+# 2-way model sharding, argmax via the cross-shard (value, id) merge.
+register_backend(
+    "slot_decoder_greedy_tp2_fused",
+    lambda ctx: _slot_runner(ctx, "greedy", model_shards=2,
+                             shard_fused=True),
+    kind="greedy",
+    ref="scan_greedy",
 )

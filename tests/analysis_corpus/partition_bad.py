@@ -2,10 +2,12 @@
 """Seeded CST-SHD violations against a toy rule table: a leaf matched
 by two rules AND a leaf matched by none (both anchor CST-SHD-001 at the
 KNOWN_PARAM_LEAVES assignment), a stale rule whose regex matches no
-leaf (CST-SHD-003 at the rule's own line), and an unregistered
-``with_sharding_constraint`` call (CST-SHD-002).  The negative cases —
+leaf (CST-SHD-003 at the rule's own line), an unregistered
+``with_sharding_constraint`` call (CST-SHD-002), and an unregistered
+``shard_map`` call (CST-SHD-004).  The negative cases —
 ``word_proj`` matching exactly one rule, the registered-looking helper
-name used as a plain attribute — must NOT fire."""
+name used as a plain attribute, the shard_map-shaped attribute read —
+must NOT fire."""
 
 import jax
 
@@ -26,3 +28,12 @@ def unregistered_constraint(x, sharding):
 def negative_not_a_constraint(table):
     # attribute access / unrelated names must not trip the site scan
     return table.constraints
+
+
+def unregistered_shard_map(body, mesh, specs):
+    return jax.shard_map(body, mesh=mesh, in_specs=specs, out_specs=specs)  # expect: CST-SHD-004
+
+
+def negative_not_a_shard_map(registry):
+    # attribute reads of the name must not trip the site scan
+    return registry.shard_map

@@ -1,0 +1,40 @@
+# corpus-rules: partitioning
+"""Seeded CST-SHD-005 violations against a toy kernel-capability
+table: a declared ``use_pallas_*`` ModelConfig flag with NO caps row
+plus a STALE caps row naming no declared flag (both anchor at the
+``DECODE_KERNEL_CAPS`` assignment), and a ``_decode_kernel_gate``
+function that hardcodes its mesh condition instead of consulting
+``kernel_supports``.  The negative cases — the covered flag, the
+helper that DOES consult the table — must not fire."""
+
+from dataclasses import dataclass
+
+DECODE_KERNEL_CAPS = {  # expect: CST-SHD-005
+    "use_pallas_covered": {"model": True, "data": False},
+    "use_pallas_ghost": {"model": False, "data": False},
+}
+
+
+def kernel_supports(flag, axis):
+    caps = DECODE_KERNEL_CAPS.get(flag)
+    return bool(caps and caps.get(axis, False))
+
+
+@dataclass
+class ModelConfig:
+    use_pallas_covered: bool = False
+    use_pallas_orphan: bool = False   # no caps row -> fires at the table
+    other_field: int = 1
+
+
+def _decode_kernel_gate(flag_name, mesh):  # expect: CST-SHD-005
+    # Hardcoded mesh condition — never consults kernel_supports.
+    if mesh is not None and mesh.shape.get("model", 1) > 1:
+        return False
+    return True
+
+
+def negative_gate_through_table(flag_name, mesh):
+    # A gate that routes through the caps table is the contract; this
+    # helper (not named _decode_kernel_gate) must not fire either way.
+    return kernel_supports(flag_name, "model")

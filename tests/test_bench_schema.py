@@ -238,6 +238,48 @@ class TestBenchKind:
         ):
             validate_record(rec)
 
+    def test_shard_fused_row_passes(self):
+        """A well-formed fused-vs-scan model-sharded decode row (ISSUE
+        14): numeric measurements, "1x2" mesh string, provenance
+        strings exempted by name."""
+        rec = good_bench()
+        rec["extra"].update({
+            "shard_fused_mesh_shape": "1x2",
+            "shard_fused_steps_per_sec": 2900.0,
+            "shard_fused_scan_steps_per_sec": 2300.0,
+            "shard_fused_vs_scan_ratio": 1.24,
+            "shard_fused_candidate_all_gather_bytes": 192,
+            "shard_fused_scan_all_gather_bytes": 98304,
+            "shard_fused_token_mismatches": 0,
+            "shard_fused_host_cores": 1.0,
+            "shard_fused_xla_flags": "--xla_force…=2",
+            "shard_fused_jax_platforms": "cpu",
+            "shard_fused_virtual_cpu": True,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "fast", [1.0]])
+    def test_non_numeric_shard_fused_field_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["shard_fused_vs_scan_ratio"] = bad
+        with pytest.raises(ValueError, match="shard_fused_vs_scan"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "small"])
+    def test_non_numeric_candidate_gather_bytes_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["shard_fused_candidate_all_gather_bytes"] = bad
+        with pytest.raises(
+            ValueError, match="shard_fused_candidate_all_gather_bytes"
+        ):
+            validate_record(rec)
+
+    def test_shard_fused_mesh_shape_still_topology_checked(self):
+        rec = good_bench()
+        rec["extra"]["shard_fused_mesh_shape"] = "one-by-two"
+        with pytest.raises(ValueError, match="mesh"):
+            validate_record(rec)
+
     def test_mesh_shape_string_passes(self):
         """*_mesh_shape fields carry the topology a row ran on (ISSUE
         9): a "2x4"-style string in declared axis order."""

@@ -103,6 +103,12 @@ class TestSharedParity:
             "slot_decoder_beam", "slot_decoder_greedy",
             "slot_decoder_beam_replicated", "slot_decoder_beam_elastic",
             "padded_rollout", "slot_rollout",
+            # ISSUE 14: the tensor-parallel fast paths — the shard_map
+            # kernel ports and the slot loop's cross-shard fused merge
+            # (plus the PR-9 gather path kept pinned alongside).
+            "fused_beam_tp2", "fused_sampler_tp2",
+            "slot_decoder_beam_tp2", "slot_decoder_beam_tp2_fused",
+            "slot_decoder_greedy_tp2_fused",
         } <= set(ALL_BACKENDS)
 
     def test_beam1_equals_greedy(self, ctx):

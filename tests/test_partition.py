@@ -342,6 +342,35 @@ class TestMeshValidation:
         assert mesh_shape_str(make_mesh({"data": 2, "model": 4})) == "2x4"
         assert mesh_shape_str(None) == "1x1"
 
+    def test_submesh_groups_deterministic(self):
+        from cst_captioning_tpu.parallel.mesh import submesh_groups
+
+        devs = list(jax.devices())
+        a = submesh_groups(devs, 2)
+        b = submesh_groups(list(reversed(devs)), 2)
+        assert len(a) == len(devs) // 2
+        assert [[d.id for d in g] for g in a] == [
+            [d.id for d in g] for g in b
+        ]
+        assert [d.id for d in a[0]] == [0, 1]
+        with pytest.raises(ValueError, match="group size"):
+            submesh_groups(devs, 0)
+
+    def test_rows_sharding_spec_rule(self):
+        from cst_captioning_tpu.parallel.partition import rows_sharding
+
+        dp = make_mesh({"data": 2, "model": 2})
+        assert rows_sharding(dp, (8, 3, 12)).spec == P("data", None, None)
+        assert rows_sharding(dp, (2, 8, 16), row_axis=1).spec == P(
+            None, "data", None
+        )
+        # non-divisible rows and data=1 meshes both replicate
+        assert rows_sharding(dp, (7, 3)).spec == P()
+        tp = make_mesh(
+            {"data": 1, "model": 2}, devices=jax.devices()[:2]
+        )
+        assert rows_sharding(tp, (8, 3)).spec == P()
+
 
 # ------------------------------------------------- model-sharded serving
 
@@ -404,6 +433,12 @@ class TestModelShardedServing:
             )
 
     def test_model_shards_gating(self):
+        """(R, M) grid validation (ISSUE 14): a grid that doesn't fit
+        the local device count refuses at engine boot with a message
+        naming both axes; an M alone exceeding the device count keeps
+        its own message.  replicas x shards that FIT no longer refuse
+        (the lifted PR-9 restriction — TestReplicaShardGrid serves
+        through one)."""
         from cst_captioning_tpu.data.build import build_dataset
         from cst_captioning_tpu.serving.engine import InferenceEngine
 
@@ -413,8 +448,10 @@ class TestModelShardedServing:
         bad = get_preset("synthetic_smoke")
         bad.serving.warmup = False
         bad.serving.model_shards = 2
-        bad.serving.replicas = 2
-        with pytest.raises(ValueError, match="requires replicas=1"):
+        bad.serving.replicas = 5          # 5 x 2 = 10 > 8 virtual devs
+        with pytest.raises(
+            ValueError, match=r"serving grid replicas=5 x model_shards=2"
+        ):
             InferenceEngine(bad, random_init=True, vocab=vocab)
         worse = get_preset("synthetic_smoke")
         worse.serving.warmup = False
@@ -426,3 +463,95 @@ class TestModelShardedServing:
         _, tp, _ = tp_world
         with pytest.raises(ValueError, match="cannot be cloned"):
             tp.clone_for_device(jax.devices()[0])
+
+    def test_submesh_clone_validates_group_size(self, tp_world):
+        _, tp, _ = tp_world
+        with pytest.raises(ValueError, match="exactly model_shards"):
+            tp.clone_for_submesh(jax.devices()[:3])
+
+
+# ------------------------------------------- replica x shard serving grid
+
+class TestReplicaShardGrid:
+    """ISSUE 14 acceptance: an (R>=2, M>=2) grid — data-parallel
+    replicas OF model-sharded engines on deterministic per-replica
+    submeshes — serves token-exact vs the offline eval path on the
+    virtual multi-device CPU mesh."""
+
+    @pytest.fixture(scope="class")
+    def grid_world(self):
+        import threading
+        import time as _time
+
+        from cst_captioning_tpu.data.build import build_dataset
+        from cst_captioning_tpu.evaluation import beam_decode_dataset
+        from cst_captioning_tpu.serving.engine import InferenceEngine
+
+        cfg = get_preset("synthetic_smoke")
+        cfg.serving.warmup = False
+        cfg.serving.num_slots = 4
+        cfg.serving.default_deadline_ms = 120_000.0
+        ds, vocab = build_dataset(cfg, cfg.eval.eval_split)
+        cfg.model.vocab_size = (len(vocab) + 1) // 2 * 2
+        base = InferenceEngine(cfg, random_init=True, vocab=vocab)
+        offline = beam_decode_dataset(base.model, base.params, ds, cfg)
+
+        import copy
+
+        cfg_grid = copy.deepcopy(cfg)
+        cfg_grid.serving.model_shards = 2
+        cfg_grid.serving.replicas = 2
+        grid = InferenceEngine(cfg_grid, params=base.params, vocab=vocab)
+        payloads = [
+            {"features": {m: a.tolist() for m, a in ds.features(i).items()}}
+            for i in range(8)
+        ]
+        return grid, ds, offline, payloads
+
+    def test_grid_serves_token_exact_vs_offline(self, grid_world):
+        import threading
+        import time as _time
+
+        from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+        grid, ds, offline, payloads = grid_world
+        rs = ReplicaSet.from_engine(grid, n_replicas=2)
+        # Deterministic submesh assignment: replica i on the id-sorted
+        # contiguous device group [i*M, (i+1)*M).
+        assert len(rs.replicas) == 2
+        for i, rep in enumerate(rs.replicas):
+            tp = rep.engine.tp_mesh
+            assert tp is not None and tp.shape["model"] == 2
+            ids = sorted(d.id for d in tp.devices.flat)
+            assert ids == [2 * i, 2 * i + 1], (i, ids)
+        grid.cache.captions.clear()
+        results, errors = {}, []
+        lock = threading.Lock()
+
+        def client(i):
+            try:
+                out = rs.submit(dict(payloads[i]), deadline_ms=120_000.0)
+                with lock:
+                    results[i] = out
+            except Exception as e:  # noqa: BLE001
+                with lock:
+                    errors.append((i, repr(e)))
+
+        with rs:
+            threads = [
+                threading.Thread(target=client, args=(i,))
+                for i in range(len(payloads))
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120.0)
+        assert not errors, errors
+        assert len(results) == len(payloads)
+        for i in range(len(payloads)):
+            assert results[i]["caption"] == offline[ds.video_id(i)], (
+                f"video {i} (replica {results[i].get('replica')}): "
+                "grid decode diverged from offline beam"
+            )
+        used = {results[i].get("replica") for i in results}
+        assert len(used) == 2, f"only replicas {used} served"

@@ -1,0 +1,284 @@
+"""ISSUE 14: the shard_map port of the fused decode kernels and the
+cross-shard top-K candidate merge.
+
+The shared harness (tests/test_decode_core.py) pins the end-to-end
+backends (`fused_beam_tp2`, `fused_sampler_tp2`,
+`slot_decoder_beam_tp2_fused`, `slot_decoder_greedy_tp2_fused`)
+token-exact against the scan references; this file pins the MERGE
+PRIMITIVES directly — including engineered EXACT ties spanning the
+vocab-tile shard boundary, the case a wrong tie order would get away
+with on random weights — plus the sampler-stream bit-exactness
+contract and the capability gate plumbing."""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.constants import PAD_ID
+from cst_captioning_tpu.decoding import core
+from cst_captioning_tpu.parallel import make_mesh
+
+G, K, V = 3, 4, 40
+M = 2
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh({"data": 1, "model": M}, devices=jax.devices()[:M])
+
+
+def _inline_beam_topk(logits, scores, finished):
+    """The decode_step beam selection, verbatim (the reference the
+    merge must reproduce bit-for-bit including tie order)."""
+    logp = jax.nn.log_softmax(logits, axis=-1).reshape(G, K, V)
+    pad_only = jnp.full((V,), core.NEG_INF).at[PAD_ID].set(0.0)
+    logp = jnp.where(finished[..., None], pad_only[None, None, :], logp)
+    total = scores[..., None] + logp
+    return jax.lax.top_k(total.reshape(G, K * V), K)
+
+
+def _st(scores, finished):
+    return core.CoreState(
+        state=None, seqs=jnp.zeros((G, K, 8), jnp.int32), scores=scores,
+        lps=None, finished=finished, tokens=None, step=None, rng=None,
+    )
+
+
+class TestTpBeamTopkMerge:
+    def _compare(self, mesh, logits, scores, finished):
+        tp = core.make_tp_beam_topk(mesh)
+        ref_sc, ref_fl = jax.jit(_inline_beam_topk)(
+            logits, scores, finished
+        )
+        got_sc, got_fl = jax.jit(
+            lambda l, s, f: tp(l, _st(s, f))
+        )(logits, scores, finished)
+        np.testing.assert_array_equal(
+            np.asarray(got_fl), np.asarray(ref_fl),
+            err_msg="cross-shard merge picked different flat keys "
+            "than the inline top-K",
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_sc), np.asarray(ref_sc), rtol=1e-6, atol=1e-6
+        )
+
+    def test_random_logits_and_finished_rows(self, mesh):
+        rng = np.random.RandomState(0)
+        self._compare(
+            mesh,
+            jnp.asarray(rng.randn(G * K, V).astype(np.float32)),
+            jnp.asarray(rng.randn(G, K).astype(np.float32)),
+            jnp.asarray(rng.rand(G, K) < 0.3),
+        )
+
+    def test_exact_tie_across_the_shard_boundary(self, mesh):
+        """Columns V/M - 1 and V/M hold BITWISE equal logits — one on
+        each shard.  The merge must resolve the tie exactly like
+        ``lax.top_k`` over the full vocab: lowest flat key (the last
+        column of shard 0) wins."""
+        rng = np.random.RandomState(1)
+        lg = rng.randn(G * K, V).astype(np.float32)
+        b = V // M
+        lg[:, b] = lg[:, b - 1]
+        # Make the tied pair the row maximum so it MUST enter the top-K.
+        lg[:, b - 1] = lg[:, b] = np.abs(lg).max() + 1.0
+        scores = jnp.zeros((G, K), jnp.float32)
+        fin = jnp.zeros((G, K), bool)
+        self._compare(mesh, jnp.asarray(lg), scores, fin)
+        tp = core.make_tp_beam_topk(mesh)
+        _, fl = jax.jit(lambda l: tp(l, _st(scores, fin)))(
+            jnp.asarray(lg)
+        )
+        fl = np.asarray(fl)
+        # The winning beam's tied twins are the two largest candidates
+        # (bitwise-equal totals): key order puts the shard-0 column
+        # first and its cross-boundary twin (key + 1) second.
+        assert (fl[:, 0] % V == b - 1).all(), fl[:, 0]
+        np.testing.assert_array_equal(fl[:, 1], fl[:, 0] + 1)
+
+    def test_finished_rows_collapse_to_pad(self, mesh):
+        rng = np.random.RandomState(2)
+        self._compare(
+            mesh,
+            jnp.asarray(rng.randn(G * K, V).astype(np.float32)),
+            jnp.asarray(rng.randn(G, K).astype(np.float32)),
+            jnp.ones((G, K), bool),
+        )
+
+
+class TestTpRowPick:
+    def test_matches_argmax_and_boundary_tie(self, mesh):
+        rng = np.random.RandomState(3)
+        lg = rng.randn(G, V).astype(np.float32)
+        b = V // M
+        lg[:, b] = lg[:, b - 1] = np.abs(lg).max() + 1.0
+        pick = core.make_tp_row_pick(mesh)
+        nxt, lp = jax.jit(pick)(jnp.asarray(lg))
+        logp = jax.nn.log_softmax(jnp.asarray(lg), axis=-1)
+        ref = jnp.argmax(logp, axis=-1)
+        np.testing.assert_array_equal(np.asarray(nxt), np.asarray(ref))
+        # lowest-index tie: the shard-0 column of the tied pair
+        assert (np.asarray(nxt) == b - 1).all()
+        ref_lp = jnp.take_along_axis(logp, ref[:, None], -1)[:, 0]
+        np.testing.assert_allclose(
+            np.asarray(lp), np.asarray(ref_lp), atol=1e-6
+        )
+
+
+def _sampler_world(rng, B=8, F=3, A=16, E=16, H=16, V=40):
+    f32 = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32))  # noqa: E731
+    return dict(
+        gx=f32(B, 4 * H), w_x=f32(E, 4 * H), wh=f32(H, 4 * H),
+        w_ctx=f32(E, 4 * H), att_wh=f32(H, A), att_v=f32(A, 1),
+        proj=f32(B, F, A), mask=jnp.ones((B, F), jnp.float32),
+        vals=f32(B, F, E), emb=f32(V, E), w_out=f32(H, V), b_out=f32(V),
+    )
+
+
+class TestShardedSamplerStream:
+    """The multinomial hash-Gumbel stream is a function of (seed, row,
+    step, GLOBAL vocab position) — sharding must not move a single
+    draw.  Tokens are BIT-exact vs the single-device scan twin, greedy
+    and multinomial, both fusion modes."""
+
+    @pytest.mark.parametrize("greedy", [True, False])
+    @pytest.mark.parametrize("fusion", ["attention", "meanpool"])
+    def test_tokens_bit_exact_vs_scan_twin(self, mesh, greedy, fusion):
+        from cst_captioning_tpu.ops import pallas_sampler as ps
+        from cst_captioning_tpu.ops import shard_decode as sd
+
+        w = _sampler_world(np.random.RandomState(7))
+        seed = jnp.asarray([123, 456], jnp.int32)
+        kw = dict(max_len=10, greedy=greedy, temperature=0.8)
+        if fusion == "attention":
+            args = (
+                w["gx"], w["w_x"], w["wh"], w["w_ctx"], w["att_wh"],
+                w["att_v"], w["proj"], w["mask"], w["vals"], w["emb"],
+                w["w_out"], w["b_out"], seed,
+            )
+            ref = ps.attlstm_sample_scan(*args, **kw)
+            got = sd.sharded_attlstm_sample(*args, mesh=mesh, **kw)
+        else:
+            args = (
+                w["gx"], w["w_x"], w["wh"], w["emb"], w["w_out"],
+                w["b_out"], seed,
+            )
+            ref = ps.lstm_sample_scan(*args, **kw)
+            got = sd.sharded_lstm_sample(*args, mesh=mesh, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(got[0]), np.asarray(ref[0])
+        )
+        np.testing.assert_allclose(
+            np.asarray(got[1]), np.asarray(ref[1]), atol=1e-5
+        )
+        np.testing.assert_array_equal(
+            np.asarray(got[2]), np.asarray(ref[2])
+        )
+
+
+class TestShardedBeamBoundaryTies:
+    def test_duplicate_vocab_columns_across_shards(self, mesh):
+        """w_out columns V/M - 1 and V/M are byte-identical, so their
+        logits tie EXACTLY at every step, one candidate per shard —
+        the sharded beam must emit the same token sequences as the
+        single-device scan twin (ties to the lower global id)."""
+        from cst_captioning_tpu.ops import pallas_beam as pb
+        from cst_captioning_tpu.ops import shard_decode as sd
+
+        w = _sampler_world(np.random.RandomState(11))
+        b = 40 // M
+        w_out = np.asarray(w["w_out"]).copy()
+        b_out = np.asarray(w["b_out"]).copy()
+        w_out[:, b] = w_out[:, b - 1]
+        # Boosted shared bias: the twins stay competitive, so the tie
+        # actually steers the search instead of hiding in the tail.
+        b_out[b] = b_out[b - 1] = float(np.abs(b_out).max()) + 4.0
+        kw = dict(beam_size=3, max_len=8)
+        args = (
+            w["gx"], w["w_x"], w["wh"], w["emb"],
+            jnp.asarray(w_out), jnp.asarray(b_out),
+        )
+        ref_seqs, ref_sc = pb.lstm_beam_scan(*args, **kw)
+        got_seqs, got_sc = sd.sharded_lstm_beam(*args, mesh=mesh, **kw)
+        np.testing.assert_array_equal(
+            np.asarray(got_seqs), np.asarray(ref_seqs)
+        )
+        np.testing.assert_allclose(
+            np.asarray(got_sc), np.asarray(ref_sc), rtol=1e-5, atol=1e-5
+        )
+        # The engineered twin columns really were selected somewhere.
+        assert (np.asarray(ref_seqs) == b - 1).any()
+
+
+class TestGatePlumbing:
+    def test_shard_decode_ok(self):
+        from cst_captioning_tpu.ops.shard_decode import shard_decode_ok
+
+        assert shard_decode_ok(40, 2, 5)
+        assert not shard_decode_ok(40, 1, 5)     # not sharded
+        assert not shard_decode_ok(41, 2, 5)     # uneven tile
+        assert not shard_decode_ok(8, 4, 3)      # tile smaller than K
+
+    def test_capability_table_covers_the_kernels(self):
+        assert core.kernel_supports("use_pallas_beam", "model")
+        assert core.kernel_supports("use_pallas_sampler", "model")
+        assert not core.kernel_supports("use_pallas_beam", "data")
+        assert not core.kernel_supports("use_pallas_attention", "model")
+        assert not core.kernel_supports("nonsense_flag", "model")
+
+    def test_model_from_config_enables_tp_fused(self, mesh):
+        """Under a model>1 mesh the gate now ENGAGES the fused flags
+        via the shard_map port (pure XLA — no TPU requirement), and
+        the model carries decode_mesh."""
+        from cst_captioning_tpu.config import get_preset
+        from cst_captioning_tpu.models import model_from_config
+
+        cfg = get_preset("synthetic_smoke")
+        cfg.model.vocab_size = 40
+        cfg.model.use_pallas_beam = True
+        cfg.model.use_pallas_sampler = True
+        m = model_from_config(cfg, mesh=mesh)
+        assert m.use_pallas_beam and m.use_pallas_sampler
+        assert m.decode_mesh is mesh
+        assert m.decode_shards == M
+
+    def test_uneven_vocab_declines_with_reason(self, mesh, caplog):
+        from cst_captioning_tpu.config import get_preset
+        from cst_captioning_tpu.models import model_from_config
+
+        cfg = get_preset("synthetic_smoke")
+        cfg.model.vocab_size = 41                 # 41 % 2 != 0
+        cfg.model.use_pallas_beam = True
+        with caplog.at_level(
+            logging.WARNING, logger="cst_captioning_tpu.models"
+        ):
+            m = model_from_config(cfg, mesh=mesh)
+        assert not m.use_pallas_beam
+        assert m.decode_mesh is None
+        assert any(
+            "does not tile evenly" in r.getMessage()
+            for r in caplog.records
+        )
+
+    def test_batch_sharded_mesh_still_declines(self, caplog):
+        from cst_captioning_tpu.config import get_preset
+        from cst_captioning_tpu.models import model_from_config
+
+        cfg = get_preset("synthetic_smoke")
+        cfg.model.vocab_size = 40
+        cfg.model.use_pallas_beam = True
+        dp = make_mesh(
+            {"data": 2, "model": 1}, devices=jax.devices()[:2]
+        )
+        with caplog.at_level(
+            logging.WARNING, logger="cst_captioning_tpu.models"
+        ):
+            m = model_from_config(cfg, mesh=dp)
+        assert not m.use_pallas_beam
+        assert any(
+            "batch sharding" in r.getMessage()
+            for r in caplog.records
+        )
