@@ -3093,6 +3093,16 @@ def main() -> int:
             extra["analysis_duration_s"] = round(_rep.duration_s, 3)
             extra["analysis_rules_active"] = len(_rep.rules_run)
             extra["analysis_cache_hit_files"] = _rep.cache_hit_files
+            # ISSUE 15: how many rule families actually gated this run,
+            # and what the dtype/shape abstract interpreter cost on top
+            # (0.0 on a warm cache hit — the flow never ran).
+            extra["analysis_families_active"] = len(_rep.rules_run)
+            from cst_captioning_tpu.analysis import typeflow as _tfmod
+
+            extra["analysis_typeflow_duration_s"] = round(
+                0.0 if _rep.cache_hit_files else _tfmod.last_duration(),
+                3,
+            )
             if not _rep.clean:
                 errors["analysis"] = "; ".join(
                     f.render() for f in _rep.findings[:5]

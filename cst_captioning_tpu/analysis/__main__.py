@@ -6,6 +6,8 @@
     python -m cst_captioning_tpu.analysis --rules single_site,donation
     python -m cst_captioning_tpu.analysis --cache          # warm reuse
     python -m cst_captioning_tpu.analysis --changed-only   # diff focus
+    python -m cst_captioning_tpu.analysis \
+        --baseline BASELINE.analysis.json --fail-on-new    # adoption
 
 Exit codes: 0 clean, 1 unsuppressed findings, 2 over the wall-clock
 budget (``ANALYSIS_BUDGET_S``, default 30 — the same discipline as
@@ -69,7 +71,21 @@ def main(argv=None) -> int:
         help="report only findings in files changed since the last "
              "cached run (implies --cache)",
     )
+    ap.add_argument(
+        "--baseline", default="",
+        help="committed baseline report JSON (a prior --json output); "
+             "findings already in the baseline are reported as known",
+    )
+    ap.add_argument(
+        "--fail-on-new", action="store_true",
+        help="with --baseline: exit 1 only on findings NOT in the "
+             "baseline — the incremental-adoption mode for new noisy "
+             "rules",
+    )
     args = ap.parse_args(argv)
+
+    if args.fail_on_new and not args.baseline:
+        ap.error("--fail-on-new requires --baseline")
 
     cache_dir = None
     if args.cache or args.cache_dir or args.changed_only:
@@ -99,8 +115,20 @@ def main(argv=None) -> int:
         changed_set = set(changed)
         findings = [f for f in findings if f.file in changed_set]
 
+    # Baseline diffing (ISSUE 15): a committed baseline report absorbs
+    # KNOWN findings so a new noisy rule can be adopted incrementally —
+    # the gate only trips on findings the baseline has never seen.
+    # Identity is the (rule, file, symbol) triple, count-aware (two
+    # same-triple findings against one baseline entry = one new), and
+    # line-number-free so unrelated edits can't churn the diff.
+    new_findings = None
+    if args.baseline:
+        new_findings = _diff_baseline(Path(args.baseline), findings)
+
     if args.json:
         rec = validate_report(report.to_dict())
+        if new_findings is not None:
+            rec["new_findings"] = [f.to_dict() for f in new_findings]
         print(json.dumps(rec, indent=2))
     elif args.sarif:
         from cst_captioning_tpu.analysis.sarif import (
@@ -122,6 +150,14 @@ def main(argv=None) -> int:
             print("\n".join(lines))
         else:
             print(report.render())
+    if new_findings is not None and not args.json:
+        known = len(findings) - len(new_findings)
+        lines = [f"NEW: {f.render()}" for f in new_findings]
+        lines.append(
+            f"baseline: {known} known finding(s) absorbed, "
+            f"{len(new_findings)} new"
+        )
+        print("\n".join(lines))
     if budget and report.duration_s > budget:
         print(
             f"ANALYSIS BUDGET EXCEEDED: {report.duration_s:.1f}s > "
@@ -129,7 +165,46 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+    if args.fail_on_new:
+        return 0 if not new_findings else 1
     return 0 if not findings else 1
+
+
+def _diff_baseline(path: Path, findings):
+    """Findings not absorbed by the baseline report at ``path`` (a
+    prior ``--json`` output, or a bare list of finding objects).
+    Raises SystemExit(2) with a named reason on an unreadable or
+    malformed baseline — a silently-empty baseline would absorb
+    nothing and fail every adopter, or worse, absorb everything."""
+    try:
+        data = json.loads(path.read_text())
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"baseline {path} unreadable: {e}", file=sys.stderr)
+        raise SystemExit(2)
+    raw = data.get("findings") if isinstance(data, dict) else data
+    if not isinstance(raw, list) or not all(
+        isinstance(f, dict)
+        and all(isinstance(f.get(k), str) for k in ("rule", "file", "symbol"))
+        for f in raw
+    ):
+        print(
+            f"baseline {path} malformed: expected a --json report or a "
+            "list of {rule, file, symbol} objects",
+            file=sys.stderr,
+        )
+        raise SystemExit(2)
+    budgets: dict = {}
+    for f in raw:
+        key = (f["rule"], f["file"], f["symbol"])
+        budgets[key] = budgets.get(key, 0) + 1
+    new = []
+    for f in findings:
+        key = (f.rule, f.file, f.symbol)
+        if budgets.get(key, 0) > 0:
+            budgets[key] -= 1
+        else:
+            new.append(f)
+    return new
 
 
 if __name__ == "__main__":

@@ -1,0 +1,260 @@
+"""CST-DTY: dtype-flow discipline over the traced surface (ISSUE 15).
+
+The PARITY tiers (docs/PARITY.md) are dtype contracts in disguise:
+"token-exact" survives only while every precision change on a decode
+path is deliberate, registered, and justified.  The bf16/int8 serving
+PR this paves will add cast sites on purpose — these rules make sure
+it CANNOT add them silently (catalogue in docs/ANALYSIS.md):
+
+* **CST-DTY-001** — every dtype-cast application (``.astype``,
+  ``lax.convert_element_type``) reachable from a registered jit root
+  must be covered by ``analysis/jit_registry.py::CAST_REGISTRY``
+  (keyed ``<file>::<qualname>``, lambda segments folded) with a
+  PARITY-tier justification; stale registry entries fire too — the
+  SHARD_MAP_REGISTRY discipline applied to precision.
+* **CST-DTY-002** — implicit weak-type promotion: a binop between a
+  value the abstract interpreter PROVES is an integer array and a bare
+  Python float literal inside traced code.  JAX floats the int array
+  to the default float silently (``tokens * 0.5`` is f32, no cast in
+  sight) — on a decode/loss path that is an unregistered precision
+  change.  Proven-int-only by construction: traced params are TOP, so
+  the rule cannot fire on uncertainty.
+* **CST-DTY-003** — accumulation-dtype discipline: inside a
+  ``CAST_REGISTRY`` entry declaring ``low_precision=True`` (the paths
+  that compute in ``compute_dtype``/``cdt`` today and will carry bf16
+  under the serving fast path), every matmul — ``dot_general``,
+  ``jnp.matmul``/``dot``/``einsum``/``tensordot`` AND the bare ``@``
+  operator — must pin ``preferred_element_type`` (the ``@`` operator
+  cannot, so it must be spelled as a pinning call).  A bf16 matmul
+  accumulating in bf16 is the classic silent-divergence source the
+  bounded-divergence contract cannot absorb.
+* **CST-DTY-004** — donation/dtype aliasing: a jit site with
+  ``donate_argnums``/``donate_argnames`` whose donated parameter is
+  dtype-cast inside the traced body.  XLA only aliases buffers whose
+  dtype (hence byte size) matches; a cast donated input silently
+  disables donation — memory doubles with zero warnings.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from typing import List, Set
+
+from cst_captioning_tpu.analysis import jit_registry
+from cst_captioning_tpu.analysis.astutil import (
+    FuncInfo,
+    ModuleInfo,
+    call_name,
+    walk_body,
+)
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+from cst_captioning_tpu.analysis import typeflow as tfmod
+from cst_captioning_tpu.analysis.typeflow import (
+    cast_sites,
+    is_int,
+    site_key,
+)
+
+_MATMUL_CALLS = ("dot_general", "dot", "matmul", "einsum", "tensordot")
+
+
+def _check_cast_registry(
+    modules: List[ModuleInfo], tf
+) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[str] = set()
+    flagged: Set[str] = set()
+    for key, mi, fn, call, kind in cast_sites(modules, tf):
+        seen.add(key)
+        if key in jit_registry.CAST_REGISTRY or key in flagged:
+            continue
+        flagged.add(key)
+        out.append(Finding(
+            "CST-DTY-001", mi.rel, call.lineno, fn.qualname,
+            f"cast site `{key}` ({kind}) is reachable from a jit root "
+            "but not registered — add it to analysis/jit_registry.py::"
+            "CAST_REGISTRY with the PARITY tier it preserves and why "
+            "(an unregistered precision change is how token-exact "
+            "silently becomes close-enough)",
+        ))
+    scanned = {m.rel for m in modules}
+    for key in sorted(jit_registry.CAST_REGISTRY):
+        rel = key.split("::", 1)[0]
+        if rel in scanned and key not in seen:
+            out.append(Finding(
+                "CST-DTY-001", "analysis/jit_registry.py", 1, key,
+                f"stale CAST_REGISTRY entry `{key}` matches no "
+                "traced cast site — the code moved; update or remove "
+                "the entry",
+            ))
+    return out
+
+
+def _check_weak_promotion(tf) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in tf.traced_functions():
+        mi = fn.module
+        types = tf.types_of(fn)
+        for node in walk_body(fn):
+            if not isinstance(node, ast.BinOp) or isinstance(
+                node.op, ast.MatMult
+            ):
+                continue
+            for lit, other in (
+                (node.right, node.left), (node.left, node.right),
+            ):
+                if not (
+                    isinstance(lit, ast.Constant)
+                    and isinstance(lit.value, float)
+                ):
+                    continue
+                v = types.value_of(other)
+                if v.array and is_int(v.dtype):
+                    out.append(Finding(
+                        "CST-DTY-002", mi.rel, node.lineno, fn.qualname,
+                        f"integer array ({v.dtype}) combined with the "
+                        f"bare float literal {lit.value!r} inside "
+                        "traced code — JAX silently floats the array "
+                        "to the default float (an unregistered "
+                        "precision change on this path); cast "
+                        "explicitly or keep the arithmetic integral",
+                    ))
+                    break
+    return out
+
+
+def _check_accumulation(
+    modules: List[ModuleInfo], tf
+) -> List[Finding]:
+    """CST-DTY-003 over the qualnames whose CAST_REGISTRY entries
+    declare ``low_precision=True``."""
+    low = {
+        key for key, e in jit_registry.CAST_REGISTRY.items()
+        if e.low_precision
+    }
+    if not low:
+        return []
+    out: List[Finding] = []
+    for fn in tf.traced_functions():
+        mi = fn.module
+        if site_key(mi, fn.qualname) not in low:
+            continue
+        for node in walk_body(fn):
+            if isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.MatMult
+            ):
+                out.append(Finding(
+                    "CST-DTY-003", mi.rel, node.lineno, fn.qualname,
+                    "bare `@` matmul on a registered low-precision "
+                    "path — the operator cannot pin an accumulation "
+                    "dtype; spell it jnp.matmul(..., "
+                    "preferred_element_type=jnp.float32) (or "
+                    "lax.dot_general) so bf16 operands accumulate in "
+                    "f32",
+                ))
+            if isinstance(node, ast.Call) and (
+                call_name(node) or ""
+            ).rsplit(".", 1)[-1] in _MATMUL_CALLS:
+                if not any(
+                    kw.arg == "preferred_element_type"
+                    for kw in node.keywords
+                ):
+                    out.append(Finding(
+                        "CST-DTY-003", mi.rel, node.lineno, fn.qualname,
+                        "matmul on a registered low-precision path "
+                        "without preferred_element_type — low-precision "
+                        "operands accumulate in their own width unless "
+                        "pinned; declare the accumulation dtype "
+                        "explicitly",
+                    ))
+    return out
+
+
+def _donated_params(call: ast.Call, fn: FuncInfo) -> Set[str]:
+    names: Set[str] = set()
+    params = [p for p in fn.params if p not in ("self", "cls")]
+    for kw in call.keywords:
+        vals: List = []
+        if isinstance(kw.value, (ast.Tuple, ast.List)):
+            vals = [
+                e.value for e in kw.value.elts
+                if isinstance(e, ast.Constant)
+            ]
+        elif isinstance(kw.value, ast.Constant):
+            vals = [kw.value.value]
+        if kw.arg == "donate_argnums":
+            for i in vals:
+                if isinstance(i, int) and i < len(params):
+                    names.add(params[i])
+        elif kw.arg == "donate_argnames":
+            names.update(v for v in vals if isinstance(v, str))
+    return names
+
+
+def _check_donated_casts(modules: List[ModuleInfo]) -> List[Finding]:
+    from cst_captioning_tpu.analysis.donation import collect_jit_sites
+    from cst_captioning_tpu.analysis.typeflow import is_cast_call
+
+    out: List[Finding] = []
+    for key, mi, call, sym in collect_jit_sites(modules):
+        donated: Set[str] = set()
+        fn: FuncInfo = None
+        if call.args:                     # jit-by-call: jit(fn, ...)
+            target = call.args[0]
+            if isinstance(target, ast.Name):
+                scope = mi.qualname_of(call)
+                for qn in (
+                    [f"{scope}.{target.id}"] if scope != "<module>"
+                    else []
+                ) + [target.id]:
+                    fn = mi.functions.get(qn)
+                    if fn is not None:
+                        break
+        else:                             # decorator site
+            fn = mi.functions.get(sym)
+        if fn is None:
+            continue
+        donated = _donated_params(call, fn)
+        if not donated:
+            continue
+        for node in walk_body(fn, into_nested=True):
+            if not isinstance(node, ast.Call):
+                continue
+            if is_cast_call(node) is None:
+                continue
+            f = node.func
+            operand = f.value if isinstance(f, ast.Attribute) else (
+                node.args[0] if node.args else None
+            )
+            root = operand
+            while isinstance(root, (ast.Attribute, ast.Subscript)):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in donated:
+                out.append(Finding(
+                    "CST-DTY-004", mi.rel, node.lineno, fn.qualname,
+                    f"donated parameter `{root.id}` of jit site "
+                    f"`{key}` is dtype-cast inside the traced body — "
+                    "XLA only aliases buffers whose dtype matches, so "
+                    "the donation is silently disabled and peak memory "
+                    "doubles; cast before the jit boundary or drop the "
+                    "donation",
+                ))
+    return out
+
+
+@register_checker("dtypeflow")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    t0 = time.perf_counter()
+    tf = tfmod.build(modules, ctx)
+    out: List[Finding] = []
+    out.extend(_check_cast_registry(modules, tf))
+    out.extend(_check_weak_promotion(tf))
+    out.extend(_check_accumulation(modules, tf))
+    out.extend(_check_donated_casts(modules))
+    tfmod.note_duration(time.perf_counter() - t0)
+    return out

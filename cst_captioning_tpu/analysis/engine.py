@@ -96,6 +96,7 @@ def _load_checkers() -> None:
     from cst_captioning_tpu.analysis import (  # noqa: F401
         configflow,
         donation,
+        dtypeflow,
         exceptions,
         jit_boundary,
         metrics_registry,
@@ -103,6 +104,7 @@ def _load_checkers() -> None:
         partitioning,
         resilience,
         rng,
+        shapeflow,
         single_site,
         thread_safety,
     )

@@ -14,7 +14,7 @@ MUST donate their state (CST-DON-001, paired with the
 
 from __future__ import annotations
 
-from typing import Dict, NamedTuple
+from typing import Dict, NamedTuple, Tuple
 
 
 class JitSite(NamedTuple):
@@ -240,6 +240,424 @@ SHARD_MAP_REGISTRY: Dict[str, str] = {
 # prose: WHAT the pin buys (which all-gather it prevents, which SPMD
 # partitioner cliff it avoids).  A constraint with no story is usually a
 # constraint papering over a placement bug.
+# Every dtype-cast site reachable from a registered jit root (ISSUE
+# 15), keyed ``<file>::<qualname>`` with ``<lambda#N>`` segments folded
+# into the enclosing def — CST-DTY-001 fails the pass on any
+# unregistered traced cast site and on stale entries.  ``tier`` names
+# the docs/PARITY.md tier the casts at this site preserve;
+# ``justification`` is reviewer-facing prose saying WHY (what the casts
+# are for, why the tier survives them).  ``low_precision=True`` marks
+# the paths that compute in a configurable dtype (``compute_dtype`` /
+# ``cdt``) — the surface the bf16/int8 serving PR will ride — and
+# subjects every matmul inside them to the CST-DTY-003
+# preferred_element_type accumulation pin.
+class CastSite(NamedTuple):
+    tier: str                      # e.g. "PARITY-EXACT", "PARITY-TIER2"
+    justification: str
+    low_precision: bool = False
+
+
+CAST_REGISTRY: Dict[str, CastSite] = {
+    # ---------------------------------------------------------- decoding
+    "decoding/beam.py::finalize_beams": CastSite(
+        "bit-exact",
+        "length-normalize divides f32 scores by an i32 length cast to "
+        "f32 — an explicit widening of exact small ints, shared by "
+        "every beam consumer (the one finalize epilogue)",
+    ),
+    "decoding/core.py::init_core": CastSite(
+        "bit-exact",
+        "seeds the carry: i32 token/finished rows and the f32 score "
+        "matrix are CREATED at their contract dtypes (no value ever "
+        "changes width)",
+    ),
+    "decoding/core.py::decode_step": CastSite(
+        "token-exact",
+        "the per-step recurrence: i32 parent/token extraction from "
+        "flat top-K keys and bool→f32 finished-mask widening — index "
+        "and mask arithmetic on exactly-representable values, "
+        "identical in every registered backend (the shared-harness "
+        "token-exact pin)",
+    ),
+    "decoding/core.py::make_tp_row_pick.pick.body": CastSite(
+        "token-exact",
+        "the TP greedy merge casts the per-shard argmax winner's "
+        "global vocab id to i32 — integer id plumbing, value-exact",
+    ),
+    "decoding/core.py::row_sample_fn.fn": CastSite(
+        "token-exact",
+        "row-keyed sampling casts the categorical draw to the carry's "
+        "i32 token dtype — id plumbing on the PARITY-r10 row-keyed "
+        "stream",
+    ),
+    # ------------------------------------------------------------ model
+    "models/captioner.py::CaptionModel._encode": CastSite(
+        "token-exact",
+        "THE compute-dtype boundary: features/projections enter at "
+        "`model.compute_dtype` (cdt), masked mean-pool accumulates "
+        "f32; under the default f32 config every cast is identity — "
+        "the bf16 serving PR changes cdt HERE and nowhere else",
+        low_precision=True,
+    ),
+    "models/captioner.py::CaptionModel._context": CastSite(
+        "token-exact",
+        "attention query/scores in cdt with the score softmax pinned "
+        "f32 (kernel twins mirror this exactly); identity under f32",
+        low_precision=True,
+    ),
+    "models/captioner.py::CaptionModel._step": CastSite(
+        "token-exact",
+        "embedding/carry rows enter the LSTM stack at cdt; identity "
+        "under f32, the kernels' cdt contract otherwise",
+        low_precision=True,
+    ),
+    "models/captioner.py::CaptionModel._logits": CastSite(
+        "token-exact",
+        "the vocab matmul runs in cdt and the logits EXIT f32 — the "
+        "one place decode scores are widened; every consumer "
+        "(beam top-K, sampler, losses) sees f32 logits by contract",
+        low_precision=True,
+    ),
+    "models/captioner.py::CaptionModel._sample_from_cache": CastSite(
+        "token-exact",
+        "bool finished-mask → f32 for the carry update — mask algebra "
+        "on {0,1}, exact in any float width",
+    ),
+    "models/captioner.py::CaptionModel._fused_gx_static": CastSite(
+        "token-exact",
+        "pre-computed gate inputs for the fused kernels at cdt with "
+        "f32 accumulation pinned at the matmul (preferred_element_type)",
+        low_precision=True,
+    ),
+    "models/captioner.py::CaptionModel.fused_beam": CastSite(
+        "token-exact",
+        "kernel operand staging: weights/activations to cdt, masks to "
+        "f32, tokens i32 — the fused-kernel calling convention whose "
+        "token-exactness vs the scan path tier-1 pins",
+        low_precision=True,
+    ),
+    "models/captioner.py::CaptionModel._fused_sample": CastSite(
+        "token-exact",
+        "sampler-kernel staging twin of fused_beam (same convention, "
+        "same pins) plus u32 seed-word extraction from the PRNG key",
+        low_precision=True,
+    ),
+    # ----------------------------------------------------------- losses
+    "ops/losses.py::_token_logprobs": CastSite(
+        "relaxed-rtol",
+        "one-hot gather of f32 log-probs casts the i32 token ids into "
+        "the take_along_axis index dtype — index plumbing",
+    ),
+    "ops/losses.py::weighted_cross_entropy": CastSite(
+        "relaxed-rtol",
+        "XE loss: i32 targets → one-hot f32, bool mask → f32 weights; "
+        "loss accumulation stays f32 (the training tier is rtol, not "
+        "bitwise — docs/PARITY.md r12)",
+    ),
+    "ops/losses.py::reward_criterion": CastSite(
+        "relaxed-rtol",
+        "PG loss twin of weighted_cross_entropy: mask/advantage "
+        "widening to f32 around f32 log-probs",
+    ),
+    # ------------------------------------------------- fused kernels/XLA
+    "ops/pallas_attention.py::dense_context_attention": CastSite(
+        "bit-exact",
+        "the dense reference the attention kernel diffs against: "
+        "scores f32, context mix f32-accumulated then rounded back to "
+        "the value dtype — the kernel's own cast structure, kept "
+        "textually parallel so the parity argument stays readable",
+        low_precision=True,
+    ),
+    "ops/pallas_attention.py::_fused_fwd_call": CastSite(
+        "bit-exact",
+        "kernel operands: mask → f32 at the pallas_call boundary "
+        "(Mosaic wants float mask lanes); values pass through at their "
+        "own dtype",
+    ),
+    "ops/pallas_beam.py::_select_beams": CastSite(
+        "token-exact",
+        "flat top-K key → (parent, token) i32 extraction — exact "
+        "integer arithmetic on flat indices",
+    ),
+    "ops/pallas_beam.py::_onehot_parent": CastSite(
+        "token-exact",
+        "parent-id equality mask → f32 one-hot for the beam-reorder "
+        "matmul — {0,1} exact in f32",
+    ),
+    "ops/pallas_beam.py::_make_beam_kernel.kernel": CastSite(
+        "token-exact",
+        "the in-kernel cdt/f32 discipline docs/PARITY.md r6 "
+        "specifies: gates and logits accumulate f32 "
+        "(preferred_element_type), activations round to cdt, "
+        "seq/token scratch lives f32-encoded and exits i32 — every "
+        "cast is part of the pinned bit-exact-vs-twin contract",
+        low_precision=True,
+    ),
+    "ops/pallas_beam.py::_make_beam_kernel.kernel.vloop": CastSite(
+        "token-exact",
+        "per-V-tile logits: cdt matmul with f32 accumulation then f32 "
+        "candidate scores — the streamed top-K operates on f32 only",
+        low_precision=True,
+    ),
+    "ops/pallas_beam.py::_beam_impl": CastSite(
+        "token-exact",
+        "kernel staging: att mask → f32 replication before the grid "
+        "launch (same convention as _fused_fwd_call)",
+    ),
+    "ops/pallas_sampler.py::_gumbel_from_counter": CastSite(
+        "token-exact",
+        "hash-Gumbel stream: u32 counter/seed arithmetic then u32 → "
+        "f32 mantissa bits — the bit-exact pinned sampler stream "
+        "(PARITY r7); every cast is integer/bit manipulation",
+    ),
+    "ops/pallas_sampler.py::_masked_vocab": CastSite(
+        "token-exact",
+        "vocab-mask widening to f32 before the NEG_INF select — {0,1} "
+        "exact",
+    ),
+    "ops/pallas_sampler.py::_make_sample_kernel.kernel": CastSite(
+        "token-exact",
+        "sampler twin of the beam kernel's cdt/f32 discipline: gates "
+        "f32-accumulated, tokens i32, Gumbel race in f32",
+        low_precision=True,
+    ),
+    "ops/pallas_sampler.py::_make_sample_kernel.kernel.vloop": CastSite(
+        "token-exact",
+        "per-V-tile logits + Gumbel keys in f32 over cdt matmul tiles",
+        low_precision=True,
+    ),
+    "ops/pallas_sampler.py::_sample_impl": CastSite(
+        "token-exact",
+        "kernel staging: mask → f32, PRNG key words → u32 seed scalars "
+        "(both words — the 64-bit seed space fix, ADVICE r5 #2)",
+    ),
+    # -------------------------------------------------------------- rnn
+    "ops/rnn.py::lstm_step": CastSite(
+        "token-exact",
+        "THE cell-dtype contract (docstring): activations/weights at "
+        "compute_dtype, gates + cell state ALWAYS f32 — c is the "
+        "additive recurrence that cannot survive bf16 accumulation; "
+        "identity under the default f32 config",
+        low_precision=True,
+    ),
+    # ----------------------------------------------------- shard_decode
+    "ops/shard_decode.py::_attention_ctx": CastSite(
+        "token-exact",
+        "shard_map port of the attention helper: same cdt/f32 "
+        "structure as the kernel it ports (scores f32, context mix "
+        "f32-accumulated)",
+        low_precision=True,
+    ),
+    "ops/shard_decode.py::_gates": CastSite(
+        "token-exact",
+        "gate GEMMs at cdt with f32 accumulation pinned — mirrors the "
+        "fused kernel's association exactly (the bitwise-twin "
+        "contract, PARITY r15)",
+        low_precision=True,
+    ),
+    "ops/shard_decode.py::_local_logits": CastSite(
+        "token-exact",
+        "per-shard vocab-tile logits: cdt matmul, f32 accumulation, "
+        "f32 exit — the candidate merge consumes f32 only",
+        low_precision=True,
+    ),
+    "ops/shard_decode.py::_sharded_beam_impl.body.step": CastSite(
+        "token-exact",
+        "bool finished → f32 freeze mask inside the sharded "
+        "recurrence — mask algebra, exact",
+    ),
+    "ops/shard_decode.py::_sharded_sample_impl.body.step": CastSite(
+        "token-exact",
+        "u32 hash-counter arithmetic keyed on GLOBAL vocab position "
+        "(the shard-invariant sampler stream) plus i32 id plumbing",
+    ),
+    # ---------------------------------------------------------- serving
+    "serving/slots.py::SlotDecoder._tick_fn.admit_one": CastSite(
+        "token-exact",
+        "admission scatter casts the incoming cache rows to the "
+        "resident slot leaves' dtypes — same-dtype by construction "
+        "(one engine produced both); the cast is a pytree-uniformity "
+        "guard, not a precision change",
+    ),
+    "serving/slots.py::SlotDecoder._tick_fn.tick": CastSite(
+        "token-exact",
+        "bool admit/free masks → f32 for the select over slot rows — "
+        "{0,1} exact; the staggered-admission row-exact pin covers it",
+    ),
+    # --------------------------------------------------------- training
+    "training/cst.py::SlotRollout._tick_fn.tick": CastSite(
+        "relaxed-rtol",
+        "rollout-slot admission mirrors the serving tick's mask "
+        "widening (the shared machinery, PARITY r10 slot-rollout "
+        "invariance)",
+    ),
+    "training/cst.py::_make_slot_step.update_fn": CastSite(
+        "relaxed-rtol",
+        "PG update widens the bool PAD mask to f32 loss weights over "
+        "the pow2-trimmed token matrix — zero-loss columns stay "
+        "exactly zero",
+    ),
+    "training/steps.py::make_xe_train_step.train_step": CastSite(
+        "relaxed-rtol",
+        "scheduled-sampling mix casts the bernoulli draw mask to the "
+        "token dtype — {0,1} integer select between teacher and "
+        "model tokens",
+    ),
+}
+
+
+# Every jit site's shape contract (ISSUE 15), keyed EXACTLY like
+# JIT_SITE_REGISTRY — CST-SHP-001 fails the pass on a jit site with no
+# ladder entry (at the site's file:line), on stale entries, and on
+# declared bucket functions that no longer resolve to a live def.
+#
+#   kind = "fixed":      the site only ever sees one shape tuple per
+#                        process/config — no quantizer needed.
+#   kind = "enumerated": runtime counts are quantized onto a finite
+#                        pre-compiled ladder; ``bucket_fns`` MUST name
+#                        the ``<file>::<qualname>`` quantizers (the
+#                        pow2/admit-bucket/bank-ladder code) so the
+#                        dataflow half can recognize laddered dims and
+#                        rot is detectable.
+#   kind = "probe":      a once-per-process capability/latency probe.
+class ShapeLadder(NamedTuple):
+    kind: str                      # fixed | enumerated | probe
+    ladder: str                    # reviewer-facing prose: the family
+    bucket_fns: Tuple[str, ...] = ()
+
+
+SHAPE_LADDER_REGISTRY: Dict[str, ShapeLadder] = {
+    # ---------------------------------------------------------- decoding
+    "decoding/beam.py::make_beam_search_fn::fn": ShapeLadder(
+        "enumerated",
+        "offline eval runs ONE (B, K, L) shape; serving reaches this "
+        "only through the engine's pow2 batch ladder (every rung "
+        "warmup-compiled)",
+        ("serving/engine.py::InferenceEngine.bucket",
+         "serving/engine.py::_default_ladder"),
+    ),
+    # ------------------------------------------------------ fused kernels
+    "ops/pallas_beam.py::attlstm_beam": ShapeLadder(
+        "fixed",
+        "one (B, K, L, V) configuration per eval/bench run; serving "
+        "dispatch arrives pre-bucketed by the engine ladder",
+    ),
+    "ops/pallas_beam.py::lstm_beam": ShapeLadder(
+        "fixed", "meanpool twin of attlstm_beam — same one-shape-per-run "
+        "discipline",
+    ),
+    "ops/pallas_sampler.py::attlstm_sample": ShapeLadder(
+        "fixed",
+        "one (B, T, V) rollout shape per run; temperature is an SMEM "
+        "scalar so it never splits the shape key",
+    ),
+    "ops/pallas_sampler.py::lstm_sample": ShapeLadder(
+        "fixed", "meanpool twin of attlstm_sample",
+    ),
+    # ----------------------------------------------------------- serving
+    "serving/engine.py::InferenceEngine._encode_fn.encode": ShapeLadder(
+        "enumerated",
+        "the pow2 batch ladder: every served batch pads up to "
+        "bucket(n); warmup compiles every rung, the coalescer never "
+        "builds an off-ladder batch",
+        ("serving/engine.py::InferenceEngine.bucket",
+         "serving/engine.py::_default_ladder"),
+    ),
+    "serving/engine.py::InferenceEngine._state_fn.from_state": ShapeLadder(
+        "enumerated",
+        "tier-2 fast path rides the SAME batch ladder as encode",
+        ("serving/engine.py::InferenceEngine.bucket",
+         "serving/engine.py::_default_ladder"),
+    ),
+    "serving/slots.py::SlotDecoder._tick_fn.tick": ShapeLadder(
+        "enumerated",
+        "(bank S, admit bucket A) grid: S walks the doubling bank "
+        "ladder, A the padded admit buckets; warmup compiles every "
+        "variant and compile_count pins zero post-warmup builds",
+        ("serving/slots.py::SlotDecoder._pad_bucket",
+         "serving/slots.py::_buckets",
+         "serving/slots.py::_bank_ladder",
+         "serving/slots.py::SlotDecoder.warm_admit_counts"),
+    ),
+    "serving/slots.py::SlotDecoder._free_fn.free_rows": ShapeLadder(
+        "enumerated",
+        "one variant per bank size on the doubling ladder",
+        ("serving/slots.py::_bank_ladder",),
+    ),
+    "serving/slots.py::SlotDecoder._resize_fn.resize": ShapeLadder(
+        "enumerated",
+        "one variant per adjacent bank transition, both directions, "
+        "all warmup-compiled",
+        ("serving/slots.py::_bank_ladder",),
+    ),
+    # ---------------------------------------------------------- training
+    "training/steps.py::make_xe_train_step::train_step": ShapeLadder(
+        "fixed",
+        "the fixed (B, L) train batch; ss_prob splits the cache as a "
+        "STATIC value, not a shape",
+    ),
+    "training/steps.py::make_greedy_sample_fn::sample": ShapeLadder(
+        "fixed", "the fixed validation batch shape",
+    ),
+    "training/cst.py::dispatch_latency_ms::<lambda>": ShapeLadder(
+        "probe", "once-per-process dispatch-latency probe on a scalar",
+    ),
+    "training/cst.py::io_callback_supported::<lambda>": ShapeLadder(
+        "probe", "once-per-process capability probe on a scalar",
+    ),
+    "training/cst.py::_make_one_graph_step::train_step": ShapeLadder(
+        "fixed", "the fixed CST batch shape",
+    ),
+    "training/cst.py::_make_pipelined_step::_rollout": ShapeLadder(
+        "fixed", "the fixed rollout batch shape (pipeline head)",
+    ),
+    "training/cst.py::_make_pipelined_step.update_and_rollout": ShapeLadder(
+        "fixed", "the fixed CST batch shape (pipeline steady state)",
+    ),
+    "training/cst.py::_make_pipelined_step.update_only": ShapeLadder(
+        "fixed", "the fixed CST batch shape (pipeline flush)",
+    ),
+    "training/cst.py::_make_split_step.rollout_chunk": ShapeLadder(
+        "fixed",
+        "fixed chunking of the fixed batch — the chunk grid is decided "
+        "once per run from config",
+    ),
+    "training/cst.py::_make_split_step.rollout_fused": ShapeLadder(
+        "fixed", "the fixed batch shape (fused-sampler rollout)",
+    ),
+    "training/cst.py::_make_split_step.greedy_chunk": ShapeLadder(
+        "fixed", "the fixed greedy-baseline batch shape",
+    ),
+    "training/cst.py::_make_split_step.update_fn": ShapeLadder(
+        "enumerated",
+        "pow2-trimmed PG length buckets at the fixed batch shape — "
+        "both CST layouts trim from the same token matrix through the "
+        "same bucket helper",
+        ("training/cst.py::_make_slot_step._trim_len",),
+    ),
+    "training/cst.py::SlotRollout.__init__::prepare": ShapeLadder(
+        "fixed",
+        "static (repeat, need_greedy) at the fixed batch shape",
+    ),
+    "training/cst.py::SlotRollout._tick_fn.tick": ShapeLadder(
+        "fixed",
+        "one slot-rollout geometry (n_slots, block) per run — a "
+        "single full-width admission bucket by construction",
+    ),
+    "training/cst.py::_make_slot_step.update_fn": ShapeLadder(
+        "enumerated",
+        "the same pow2 length-trim buckets as the split-step update",
+        ("training/cst.py::_make_slot_step._trim_len",),
+    ),
+    # ------------------------------------------------------------- tools
+    "tools/overlap_sim.py::simulate::<lambda>": ShapeLadder(
+        "fixed",
+        "bench-only simulator: one shape per simulated configuration "
+        "per bench invocation",
+    ),
+}
+
+
 SHARDING_CONSTRAINT_REGISTRY: Dict[str, str] = {
     "parallel/partition.py::constrain": (
         "the one raw-constraint helper every boundary pin can route "

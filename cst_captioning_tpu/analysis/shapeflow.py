@@ -1,0 +1,360 @@
+"""CST-SHP: static recompile-storm detection (ISSUE 15).
+
+The jit_registry records WHAT bounds each site's recompiles as prose;
+the shape discipline that makes the prose true — pow2 slot banks,
+padded admit buckets, the serving batch ladder — lives in code the
+registry never sees.  These rules close the gap (catalogue in
+docs/ANALYSIS.md):
+
+* **CST-SHP-001** — every jit site must have an
+  ``analysis/jit_registry.py::SHAPE_LADDER_REGISTRY`` entry declaring
+  the shape family its array params may see (``fixed`` /
+  ``enumerated`` / ``probe``) and, for enumerated ladders, the bucket
+  functions that quantize runtime counts onto the ladder.  Stale
+  entries and bucket functions that resolve to no live def fire too.
+  On top, the dataflow half: a device-array creation whose dimension
+  PROVABLY derives from ``len(...)`` (the abstract interpreter's
+  data-dependent taint) without passing a registered bucket function,
+  in serving/decoding dispatch code, is a statically-detected
+  recompile storm — one compile per distinct queue depth.
+* **CST-SHP-002** — AOT enumeration drift: in a class that ships the
+  artifact contract (defines BOTH ``aot_variant_keys`` and
+  ``aot_lower``), (a) the f-string variant-key prefixes the two
+  methods emit must agree, (b) every compiled-variant builder the
+  class defines (methods named ``_*_fn``) must be lowered by
+  ``aot_lower``, and (c) the ladder sources ``warmup`` walks
+  (``bank_ladder``, ``warm_admit_counts``) must also drive
+  ``aot_variant_keys`` — a reachable (bank, bucket, transition)
+  combination missing from the AOT enumeration is a cold-start
+  surprise the loader cannot refuse.
+* **CST-SHP-003** — a Python ``for``/``while`` whose trip count reads
+  ``.shape`` inside traced code: the loop unrolls at trace time, once
+  per shape — a per-shape graph-size blowup the scan/fori primitives
+  exist to avoid.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from typing import Dict, List, Optional, Set, Tuple
+
+from cst_captioning_tpu.analysis import jit_registry
+from cst_captioning_tpu.analysis.astutil import (
+    ModuleInfo,
+    call_name,
+    walk_body,
+)
+from cst_captioning_tpu.analysis.engine import (
+    CheckContext,
+    Finding,
+    register_checker,
+)
+from cst_captioning_tpu.analysis import typeflow as tfmod
+from cst_captioning_tpu.analysis.typeflow import dim_is_data_dependent
+
+# Device-array creators whose shape argument the dataflow half audits.
+_CREATORS = ("zeros", "ones", "empty", "full")
+# Dispatch surfaces where a data-dependent device shape means a
+# recompile per distinct count (host-side metrics/eval assembly is out
+# of scope — it never crosses a jit boundary at varying shapes).
+# Matched as path COMPONENTS so the corpus can mirror the layout under
+# a subdirectory, like the thread-safety corpus does.
+_DISPATCH_DIRS = ("serving", "decoding")
+
+
+def _in_dispatch_dirs(rel: str) -> bool:
+    return any(seg in _DISPATCH_DIRS for seg in rel.split("/")[:-1])
+
+
+def _ladder_entry_ok(entry) -> Optional[str]:
+    if entry.kind not in ("fixed", "enumerated", "probe"):
+        return f"unknown ladder kind {entry.kind!r}"
+    if entry.kind == "enumerated" and not entry.bucket_fns:
+        return (
+            "an enumerated ladder must name the bucket function(s) "
+            "that quantize runtime counts onto it"
+        )
+    return None
+
+
+def _check_ladder_registry(
+    modules: List[ModuleInfo],
+) -> List[Finding]:
+    from cst_captioning_tpu.analysis.donation import collect_jit_sites
+
+    out: List[Finding] = []
+    reg = jit_registry.SHAPE_LADDER_REGISTRY
+    seen: Set[str] = set()
+    for key, mi, call, sym in collect_jit_sites(modules):
+        seen.add(key)
+        entry = reg.get(key)
+        if entry is None:
+            out.append(Finding(
+                "CST-SHP-001", mi.rel, call.lineno, sym,
+                f"jit site `{key}` has no SHAPE_LADDER_REGISTRY entry "
+                "— declare the shape family its array params may see "
+                "(fixed / enumerated ladder / probe) and, for "
+                "ladders, the bucket functions that enforce it; an "
+                "unladdered site is a recompile storm waiting for a "
+                "data-dependent shape",
+            ))
+            continue
+        bad = _ladder_entry_ok(entry)
+        if bad:
+            out.append(Finding(
+                "CST-SHP-001", mi.rel, call.lineno, sym,
+                f"SHAPE_LADDER_REGISTRY entry `{key}`: {bad}",
+            ))
+    scanned = {m.rel for m in modules}
+    # qualnames defined anywhere in the scan, for bucket-fn rot checks
+    defined: Set[str] = set()
+    for mi in modules:
+        for qn in mi.functions:
+            defined.add(f"{mi.rel}::{qn}")
+    for key in sorted(reg):
+        rel = key.split("::", 1)[0]
+        if rel not in scanned:
+            continue
+        if key not in seen:
+            out.append(Finding(
+                "CST-SHP-001", "analysis/jit_registry.py", 1, key,
+                f"stale SHAPE_LADDER_REGISTRY entry `{key}` matches "
+                "no live jit site — the code moved; update or remove "
+                "the entry",
+            ))
+        for fq in reg[key].bucket_fns:
+            if fq.split("::", 1)[0] in scanned and fq not in defined:
+                out.append(Finding(
+                    "CST-SHP-001", "analysis/jit_registry.py", 1, key,
+                    f"ladder entry `{key}` names bucket function "
+                    f"`{fq}` which resolves to no live def — the "
+                    "quantizer was renamed or removed; the ladder "
+                    "prose no longer matches the code",
+                ))
+    return out
+
+
+def _check_data_dependent_dims(
+    modules: List[ModuleInfo], tf
+) -> List[Finding]:
+    out: List[Finding] = []
+    for mi in modules:
+        if not _in_dispatch_dirs(mi.rel):
+            continue
+        for qn, fn in mi.functions.items():
+            if not isinstance(
+                fn.node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ):
+                continue
+            types = tf.types_of(fn)
+            for node in walk_body(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node) or ""
+                parts = name.split(".")
+                # Device-array creators only: a len()-shaped np host
+                # buffer is result assembly (it never compiles); a
+                # len()-shaped jnp array IS a per-count compile the
+                # moment it meets a jit boundary.
+                if parts[-1] not in _CREATORS or len(parts) < 2 or (
+                    parts[0] not in ("jnp", "jax")
+                ):
+                    continue
+                if not node.args:
+                    continue
+                shape = types._shape_arg(node.args[0], 0)
+                if not shape:
+                    continue
+                for d in shape:
+                    if dim_is_data_dependent(d):
+                        out.append(Finding(
+                            "CST-SHP-001", mi.rel, node.lineno, qn,
+                            f"array created with data-dependent dim "
+                            f"`{d}` (derives from len(...) with no "
+                            "registered ladder bucket in the chain) — "
+                            "a distinct compile per distinct count if "
+                            "this shape reaches a jit boundary; route "
+                            "the count through the site's bucket "
+                            "function first",
+                        ))
+                        break
+    return out
+
+
+# --------------------------------------------------- AOT drift (SHP-002)
+
+def _fstring_key_prefixes(fn_node: ast.AST) -> Set[str]:
+    """Prefixes (text up to the first ':') of f-string/str literals
+    that look like variant keys (``tick:S8:A4``)."""
+    out: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.JoinedStr):
+            first = n.values[0] if n.values else None
+            if isinstance(first, ast.Constant) and isinstance(
+                first.value, str
+            ) and ":" in first.value:
+                out.add(first.value.split(":", 1)[0])
+        elif isinstance(n, ast.Constant) and isinstance(n.value, str):
+            if ":" in n.value and n.value.split(":", 1)[0].isidentifier():
+                out.add(n.value.split(":", 1)[0])
+    return out
+
+
+def _self_attr_reads(fn_node: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for n in ast.walk(fn_node):
+        if isinstance(n, ast.Attribute) and isinstance(
+            n.value, ast.Name
+        ) and n.value.id == "self":
+            out.add(n.attr)
+    return out
+
+
+# Ladder sources the enumeration must share with warmup: the bank
+# ladder and the admit-bucket closure.
+_LADDER_SOURCES = ("bank_ladder", "warm_admit_counts")
+
+
+def aot_contract_classes(
+    modules: List[ModuleInfo],
+) -> List[Tuple[ModuleInfo, str, Dict[str, ast.AST]]]:
+    """Classes shipping the AOT artifact contract (both
+    ``aot_variant_keys`` and ``aot_lower``) — the drift-rule surface,
+    exposed so the vacuous-green guard can pin discovery of the real
+    ``SlotDecoder``."""
+    out = []
+    for mi in modules:
+        for cls_name, cls in mi.classes.items():
+            methods: Dict[str, ast.AST] = {}
+            for stmt in cls.body:
+                if isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    methods[stmt.name] = stmt
+            if "aot_variant_keys" in methods and "aot_lower" in methods:
+                out.append((mi, cls_name, methods))
+    return out
+
+
+def _check_aot_drift(modules: List[ModuleInfo]) -> List[Finding]:
+    out: List[Finding] = []
+    for mi, cls_name, methods in aot_contract_classes(modules):
+        keys_fn = methods["aot_variant_keys"]
+        lower_fn = methods["aot_lower"]
+        kp = _fstring_key_prefixes(keys_fn)
+        lp = _fstring_key_prefixes(lower_fn)
+        if kp != lp:
+            out.append(Finding(
+                "CST-SHP-002", mi.rel, keys_fn.lineno,
+                f"{cls_name}.aot_variant_keys",
+                f"variant-key families drifted: aot_variant_keys "
+                f"emits {sorted(kp)} but aot_lower builds "
+                f"{sorted(lp)} — the loader's key-set refusal "
+                "cannot catch a family the enumeration never names",
+            ))
+        lowered = _self_attr_reads(lower_fn)
+        for name, m in sorted(methods.items()):
+            if name.startswith("_") and name.endswith("_fn") and (
+                name not in lowered
+            ):
+                out.append(Finding(
+                    "CST-SHP-002", mi.rel, m.lineno,
+                    f"{cls_name}.{name}",
+                    f"compiled-variant builder `{name}` is never "
+                    "lowered by aot_lower — its variants compile "
+                    "at first traffic instead of boot (the "
+                    "cold-start surprise the artifact exists to "
+                    "remove); add it to the AOT enumeration",
+                ))
+        if "warmup" in methods:
+            warm_reads = _self_attr_reads(methods["warmup"])
+            key_reads = _self_attr_reads(keys_fn)
+            for src in _LADDER_SOURCES:
+                if src in warm_reads and src not in key_reads:
+                    out.append(Finding(
+                        "CST-SHP-002", mi.rel, keys_fn.lineno,
+                        f"{cls_name}.aot_variant_keys",
+                        f"warmup walks `{src}` but aot_variant_keys "
+                        "never reads it — the enumeration cannot "
+                        "cover combinations it does not iterate; "
+                        "drive both from the same ladder source",
+                    ))
+    return out
+
+
+# ------------------------------------------- trace-time unroll (SHP-003)
+
+def _reads_shape(expr: ast.AST, types) -> Optional[str]:
+    """A ``.shape`` read inside ``expr`` (directly or through the
+    def-use chains), rendered for the finding message — or None."""
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Attribute) and n.attr in ("shape", "ndim"):
+            from cst_captioning_tpu.analysis.astutil import dotted
+
+            return dotted(n) or f"<expr>.{n.attr}"
+        if isinstance(n, ast.Name):
+            b = types.du.reaching_def(n)
+            if b is not None and b.value is not None and b.kind in (
+                "assign", "walrus",
+            ):
+                for sub in ast.walk(b.value):
+                    if isinstance(sub, ast.Attribute) and sub.attr in (
+                        "shape", "ndim",
+                    ):
+                        from cst_captioning_tpu.analysis.astutil import (
+                            dotted,
+                        )
+
+                        return dotted(sub) or f"<expr>.{sub.attr}"
+    return None
+
+
+def _check_shape_unroll(tf) -> List[Finding]:
+    out: List[Finding] = []
+    for fn in tf.traced_functions():
+        mi = fn.module
+        types = tf.types_of(fn)
+        for node in walk_body(fn):
+            if isinstance(node, ast.For):
+                it = node.iter
+                if isinstance(it, ast.Call) and (
+                    call_name(it) or ""
+                ).rsplit(".", 1)[-1] == "range":
+                    for a in it.args:
+                        hit = _reads_shape(a, types)
+                        if hit:
+                            out.append(Finding(
+                                "CST-SHP-003", mi.rel, it.lineno,
+                                fn.qualname,
+                                f"Python `for … in range({hit})` "
+                                "inside traced code unrolls the loop "
+                                "at trace time, once per shape — a "
+                                "per-shape graph-size blowup; use "
+                                "lax.scan/fori_loop (or hoist the "
+                                "loop out of the jit boundary)",
+                            ))
+                            break
+            elif isinstance(node, ast.While):
+                hit = _reads_shape(node.test, types)
+                if hit:
+                    out.append(Finding(
+                        "CST-SHP-003", mi.rel, node.lineno, fn.qualname,
+                        f"Python `while` on `{hit}` inside traced "
+                        "code — trip count is fixed at trace time; "
+                        "use lax.while_loop",
+                    ))
+    return out
+
+
+@register_checker("shapeflow")
+def check(modules: List[ModuleInfo], ctx: CheckContext) -> List[Finding]:
+    t0 = time.perf_counter()
+    tf = tfmod.build(modules, ctx)
+    out: List[Finding] = []
+    out.extend(_check_ladder_registry(modules))
+    out.extend(_check_data_dependent_dims(modules, tf))
+    out.extend(_check_aot_drift(modules))
+    out.extend(_check_shape_unroll(tf))
+    tfmod.note_duration(time.perf_counter() - t0)
+    return out

@@ -1,0 +1,701 @@
+"""The dtype/shape abstract interpreter (ISSUE 15): the layer under
+CST-DTY and CST-SHP.
+
+The low-precision serving path the ROADMAP wants (bf16/int8 decode with
+a bounded-divergence contract) is exactly the kind of change the PARITY
+tiers cannot survive unaudited: one implicit upcast and the "token-exact"
+tier silently becomes "close enough", one unregistered downcast and
+nobody can say which tier a path is on.  Likewise the jit_registry
+records *that* a site compiles but not *what shapes* it may see — the
+pow2/admit-bucket shape discipline lives in prose.  This module turns
+both contracts into dataflow facts:
+
+* an :class:`AbstractValue` is a ``(dtype-lattice element, shape
+  symbol tuple)`` pair.  The dtype lattice has JAX's weak types as
+  first-class elements (a bare Python scalar is ``wi``/``wf``, which
+  promotion DROPS against any concrete array dtype — the rule JAX
+  implements and reviewers forget); ``any`` is top, so precision only
+  ever errs toward silence, never toward false findings.
+* :class:`TypeFlow` rides the PR-12 def-use chains
+  (``analysis/dataflow.py``) and the CST-JIT traced-set closure: every
+  function reachable from a registered jit root gets its expressions
+  abstractly evaluated in lexical order — array creators
+  (``jnp.zeros``/``arange``/``PRNGKey``/literals), dtype transformers
+  (``astype``, ``convert_element_type``, ``.at[...]`` updates, binop
+  promotion, matmul ``preferred_element_type``), and shape algebra
+  over config-knob symbols (``self.S``, ``cfg.serving.num_slots``,
+  ``V // M`` vocab tiles) — the same knob vocabulary CST-CFG resolves.
+* interprocedural: a call into the package evaluates the callee's
+  return expressions under the mapped argument values (memoized on the
+  argument dtype signature, depth-bounded), so ``lstm_step``'s result
+  dtype is known at its serving call sites.
+
+Pure stdlib-``ast`` like the rest of the engine: reads source, never
+imports jax or the package under analysis.  The checkers built on top
+(``analysis/dtypeflow.py``, ``analysis/shapeflow.py``) consume the
+facts; this module emits none itself.
+"""
+
+from __future__ import annotations
+
+import ast
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple, Union
+
+from cst_captioning_tpu.analysis.astutil import (
+    FuncInfo,
+    ModuleInfo,
+    call_name,
+    dotted,
+    walk_body,
+)
+from cst_captioning_tpu.analysis.dataflow import DefUse
+
+__all__ = [
+    "AbstractValue",
+    "TypeFlow",
+    "build",
+    "cast_sites",
+    "site_key",
+    "promote",
+    "last_duration",
+]
+
+# --------------------------------------------------------- dtype lattice
+#
+# Elements: 'bottom' (never), concrete array dtypes, the two weak
+# scalars ('wi' python int, 'wf' python float), 'key' (PRNG keys), and
+# 'any' (top — unknown, e.g. a traced parameter).
+
+FLOATS = ("f64", "f32", "bf16", "f16")
+INTS = ("i64", "i32", "i16", "i8", "u64", "u32", "u16", "u8")
+CONCRETE = FLOATS + INTS + ("bool", "key")
+
+_FLOAT_RANK = {"f16": 1, "bf16": 1, "f32": 2, "f64": 3}
+_INT_RANK = {
+    "i8": 1, "u8": 1, "i16": 2, "u16": 2,
+    "i32": 3, "u32": 3, "i64": 4, "u64": 4,
+}
+
+# dotted-name / string spellings -> lattice element
+_DTYPE_NAMES = {
+    "float64": "f64", "float32": "f32", "bfloat16": "bf16",
+    "float16": "f16", "int64": "i64", "int32": "i32", "int16": "i16",
+    "int8": "i8", "uint64": "u64", "uint32": "u32", "uint16": "u16",
+    "uint8": "u8", "bool": "bool", "bool_": "bool", "float": "wf",
+    "int": "wi",
+}
+
+
+def dtype_of_name(name: str) -> Optional[str]:
+    """Lattice element for a dtype spelled as a (dotted) name or
+    string literal (``jnp.float32``, ``"bfloat16"``, ``np.int32``)."""
+    return _DTYPE_NAMES.get(name.rsplit(".", 1)[-1])
+
+
+def is_float(dt: str) -> bool:
+    return dt in _FLOAT_RANK
+
+
+def is_int(dt: str) -> bool:
+    return dt in _INT_RANK
+
+
+def promote(a: str, b: str) -> str:
+    """JAX-style binary promotion over the lattice, including the weak
+    rules: a Python scalar (``wi``/``wf``) NEVER widens a concrete
+    array dtype — ``bf16 * 0.5`` stays bf16 — but DOES float an int
+    array (``i32 * 0.5`` -> the default float), which is the silent
+    flip CST-DTY-002 exists to catch."""
+    if a == b:
+        return a
+    if "any" in (a, b) or "bottom" in (a, b) or "key" in (a, b):
+        return "any"
+    # weak scalars
+    if a in ("wi", "wf") and b in ("wi", "wf"):
+        return "wf" if "wf" in (a, b) else "wi"
+    for weak, strong in ((a, b), (b, a)):
+        if weak == "wi" and strong in CONCRETE:
+            return strong if strong != "bool" else "i32"
+        if weak == "wf" and strong in CONCRETE:
+            # weak float against an int/bool array floats it to the
+            # DEFAULT float (f32 under the x64-off regime) — the
+            # implicit upcast, not a width-preserving move.
+            return strong if is_float(strong) else "f32"
+    if is_float(a) and is_float(b):
+        if {a, b} == {"bf16", "f16"}:
+            return "f32"
+        return a if _FLOAT_RANK[a] >= _FLOAT_RANK[b] else b
+    if is_int(a) and is_int(b):
+        return a if _INT_RANK[a] >= _INT_RANK[b] else b
+    if "bool" in (a, b):
+        other = b if a == "bool" else a
+        return other
+    # int x float -> the float side
+    fl = a if is_float(a) else b
+    return fl
+
+
+# ---------------------------------------------------------- shape dims
+#
+# A dim is an int, a symbol string (config knob / attribute chain /
+# derived expression), or a DATA-DEPENDENT symbol prefixed "?" — the
+# taint CST-SHP-001 chases (a "?"-dim reaching a jit boundary without
+# a ladder bucket in its derivation is a statically-visible recompile
+# storm).
+
+Dim = Union[int, str]
+
+
+def dim_is_data_dependent(d: Dim) -> bool:
+    return isinstance(d, str) and d.startswith("?")
+
+
+def _dim_binop(op: ast.AST, a: Dim, b: Dim) -> Dim:
+    if isinstance(a, int) and isinstance(b, int):
+        try:
+            if isinstance(op, ast.Add):
+                return a + b
+            if isinstance(op, ast.Sub):
+                return a - b
+            if isinstance(op, ast.Mult):
+                return a * b
+            if isinstance(op, ast.FloorDiv) and b:
+                return a // b
+        except Exception:
+            pass
+    sym = f"({a}{_OPS.get(type(op), '?')}{b})"
+    if dim_is_data_dependent(a) or dim_is_data_dependent(b):
+        return "?" + sym
+    return sym
+
+
+_OPS = {
+    ast.Add: "+", ast.Sub: "-", ast.Mult: "*",
+    ast.FloorDiv: "//", ast.Div: "/", ast.Mod: "%",
+}
+
+
+@dataclass(frozen=True)
+class AbstractValue:
+    """One ``(dtype, shape)`` lattice point.  ``shape`` is None when
+    unknown; ``array`` is None when array-ness itself is unknown (a
+    traced parameter), which the rules treat as "do not fire"."""
+
+    dtype: str = "any"
+    shape: Optional[Tuple[Dim, ...]] = None
+    array: Optional[bool] = None
+
+    def with_dtype(self, dt: str) -> "AbstractValue":
+        return AbstractValue(dt, self.shape, self.array)
+
+
+ANY = AbstractValue()
+WEAK_INT = AbstractValue("wi", (), False)
+WEAK_FLOAT = AbstractValue("wf", (), False)
+BOOL_SCALAR = AbstractValue("bool", (), False)
+PY = AbstractValue("any", None, False)        # non-numeric python value
+KEY = AbstractValue("key", None, True)
+
+# array creators: callee basename -> default dtype
+_CREATORS = {
+    "zeros": "f32", "ones": "f32", "empty": "f32", "full": "f32",
+}
+_LIKE_CREATORS = ("zeros_like", "ones_like", "full_like", "empty_like")
+_MATMULS = ("dot_general", "dot", "matmul", "einsum", "tensordot")
+_PASSTHROUGH = (
+    "sum", "mean", "max", "min", "abs", "tanh", "exp", "log", "sqrt",
+    "negative", "maximum", "minimum", "where", "squeeze", "reshape",
+    "transpose", "swapaxes", "concatenate", "stack", "split",
+    "expand_dims", "clip", "cumsum", "flip", "roll", "broadcast_to",
+    "dynamic_slice", "dynamic_update_slice", "select", "tile",
+)
+_RANDOM_FLOAT = ("uniform", "normal", "gumbel", "truncated_normal")
+_KEY_FNS = ("PRNGKey", "key", "split", "fold_in", "clone")
+_ARG_FNS = ("argmax", "argmin", "argsort", "searchsorted")
+_CAST_ATTRS = ("astype",)
+_CONVERT_FNS = ("convert_element_type",)
+
+
+def is_cast_call(node: ast.Call) -> Optional[str]:
+    """``"astype"`` / ``"convert_element_type"`` when ``node`` is a
+    dtype-cast application, else None."""
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in _CAST_ATTRS:
+        return f.attr
+    name = (call_name(node) or "").rsplit(".", 1)[-1]
+    if name in _CONVERT_FNS:
+        return name
+    return None
+
+
+def site_key(mi: ModuleInfo, qualname: str) -> str:
+    """Registry key for a cast site: ``<file>::<qualname>`` with
+    ``<lambda#N>`` segments folded into their enclosing def (lambda
+    sequence numbers are not stable under reformatting)."""
+    parts = [
+        p for p in qualname.split(".") if not p.startswith("<lambda")
+    ]
+    return f"{mi.rel}::{'.'.join(parts) or '<module>'}"
+
+
+class _FnTypes:
+    """Abstract values for one function's expressions, evaluated in
+    lexical order over the def-use chains."""
+
+    def __init__(self, tf: "TypeFlow", fn: FuncInfo):
+        self.tf = tf
+        self.fn = fn
+        self.du = tf.defuse(fn)
+        self._memo: Dict[int, AbstractValue] = {}
+
+    def value_of(self, node: ast.AST, depth: int = 0) -> AbstractValue:
+        key = id(node)
+        if key in self._memo:
+            return self._memo[key]
+        if depth > 24:
+            return ANY
+        self._memo[key] = ANY           # cycle guard
+        v = self._eval(node, depth)
+        self._memo[key] = v
+        return v
+
+    # ------------------------------------------------------------ eval
+    def _eval(self, node: ast.AST, depth: int) -> AbstractValue:
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool):
+                return BOOL_SCALAR
+            if isinstance(v, int):
+                return WEAK_INT
+            if isinstance(v, float):
+                return WEAK_FLOAT
+            return PY
+        if isinstance(node, ast.Name):
+            b = self.du.reaching_def(node)
+            if b is None or b.kind == "param":
+                return self.tf.param_value(self.fn, node.id)
+            if b.value is None:
+                return ANY
+            return self.value_of(b.value, depth + 1)
+        if isinstance(node, ast.BinOp):
+            a = self.value_of(node.left, depth + 1)
+            b = self.value_of(node.right, depth + 1)
+            arr = (
+                True if a.array or b.array
+                else (False if a.array is False and b.array is False
+                      else None)
+            )
+            return AbstractValue(promote(a.dtype, b.dtype), None, arr)
+        if isinstance(node, ast.UnaryOp):
+            v = self.value_of(node.operand, depth + 1)
+            if isinstance(node.op, ast.Not):
+                return AbstractValue("bool", v.shape, v.array)
+            return v
+        if isinstance(node, ast.Compare):
+            arr = any(
+                self.value_of(s, depth + 1).array
+                for s in [node.left, *node.comparators]
+            )
+            return AbstractValue("bool", None, True if arr else None)
+        if isinstance(node, ast.BoolOp):
+            return AbstractValue("bool", None, None)
+        if isinstance(node, ast.IfExp):
+            a = self.value_of(node.body, depth + 1)
+            b = self.value_of(node.orelse, depth + 1)
+            return AbstractValue(promote(a.dtype, b.dtype), None, a.array)
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, depth)
+        if isinstance(node, ast.Subscript):
+            base = self.value_of(node.value, depth + 1)
+            return AbstractValue(base.dtype, None, base.array)
+        if isinstance(node, ast.Attribute):
+            if node.attr in ("T", "real", "mT"):
+                return self.value_of(node.value, depth + 1)
+            return ANY
+        if isinstance(node, (ast.Tuple, ast.List)):
+            return PY
+        return ANY
+
+    def _dtype_arg(self, expr: ast.AST, depth: int) -> Optional[str]:
+        """Lattice element for a dtype-position expression."""
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            return dtype_of_name(expr.value)
+        name = dotted(expr)
+        if name:
+            dt = dtype_of_name(name)
+            if dt:
+                return dt
+            # ``x.dtype`` / ``self.compute_dtype`` style: the dtype OF
+            # another abstract value when we know it
+            if name.endswith(".dtype"):
+                base = expr
+                while isinstance(base, ast.Attribute):
+                    base = base.value
+                v = self.value_of(base, depth + 1)
+                if isinstance(expr, ast.Attribute) and isinstance(
+                    expr.value, ast.Name
+                ):
+                    v = self.value_of(expr.value, depth + 1)
+                if v.dtype not in ("any", "bottom"):
+                    return v.dtype
+        if isinstance(expr, ast.Call):
+            # jnp.dtype(X) wrapper
+            if (call_name(expr) or "").rsplit(".", 1)[-1] == "dtype" and (
+                expr.args
+            ):
+                return self._dtype_arg(expr.args[0], depth + 1)
+        return None
+
+    def _shape_arg(
+        self, expr: ast.AST, depth: int
+    ) -> Optional[Tuple[Dim, ...]]:
+        if isinstance(expr, (ast.Tuple, ast.List)):
+            return tuple(
+                self.dim_of(e, depth + 1) for e in expr.elts
+            )
+        d = self.dim_of(expr, depth + 1)
+        return (d,)
+
+    def dim_of(self, expr: ast.AST, depth: int = 0) -> Dim:
+        """Symbolic value of one shape-dimension expression: ints fold,
+        attribute chains become knob symbols, ``len(...)`` taints the
+        dim data-dependent, a registered ladder-bucket call launders
+        the taint (the shape is laddered by construction)."""
+        if depth > 24:
+            return "?"
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+            return expr.value
+        if isinstance(expr, ast.Name):
+            b = self.du.reaching_def(expr)
+            if b is None or b.kind == "param":
+                return expr.id       # symbol: parameter / free name
+            if b.value is None or b.kind == "for":
+                # loop targets and valueless bindings stay plain
+                # symbols: unknown is NOT data-dependent — the taint
+                # below is reserved for PROVEN len() derivations.
+                return expr.id
+            return self.dim_of(b.value, depth + 1)
+        if isinstance(expr, ast.Attribute):
+            return dotted(expr) or expr.attr
+        if isinstance(expr, ast.BinOp):
+            return _dim_binop(
+                expr.op,
+                self.dim_of(expr.left, depth + 1),
+                self.dim_of(expr.right, depth + 1),
+            )
+        if isinstance(expr, ast.Call):
+            name = (call_name(expr) or "").rsplit(".", 1)[-1]
+            if name == "len":
+                return "?len"
+            if name in self.tf.bucket_fn_names:
+                return f"bucket:{name}"
+            if name in ("min", "max") and expr.args:
+                dims = [self.dim_of(a, depth + 1) for a in expr.args]
+                if any(dim_is_data_dependent(d) for d in dims):
+                    # min(len(x), cap) is still data-dependent unless a
+                    # bucket call quantizes it afterwards
+                    return "?" + f"{name}({','.join(map(str, dims))})"
+                return f"{name}({','.join(map(str, dims))})"
+            if name == "int":
+                return self.dim_of(expr.args[0], depth + 1) if (
+                    expr.args
+                ) else "?"
+            return f"{name}()"
+        if isinstance(expr, ast.Subscript):
+            base = expr.value
+            if isinstance(base, ast.Attribute) and base.attr == "shape":
+                owner = dotted(base.value) or "x"
+                return f"{owner}.shape[…]"
+        return "unknown"
+
+    def _eval_call(self, node: ast.Call, depth: int) -> AbstractValue:
+        cast = is_cast_call(node)
+        if cast is not None:
+            if cast in _CAST_ATTRS:
+                operand = node.func.value          # type: ignore[union-attr]
+                dt_expr = node.args[0] if node.args else None
+            else:
+                operand = node.args[0] if node.args else None
+                dt_expr = node.args[1] if len(node.args) > 1 else next(
+                    (kw.value for kw in node.keywords
+                     if kw.arg == "new_dtype"), None
+                )
+            base = self.value_of(operand, depth + 1) if (
+                operand is not None
+            ) else ANY
+            dt = self._dtype_arg(dt_expr, depth) if (
+                dt_expr is not None
+            ) else None
+            return AbstractValue(dt or "any", base.shape, True)
+        name = call_name(node) or ""
+        base_name = name.rsplit(".", 1)[-1]
+        if base_name in _CREATORS:
+            dt = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = self._dtype_arg(kw.value, depth)
+            # jnp.full's positional dtype sits at arg 2; zeros/ones at 1
+            pos = 2 if base_name == "full" else 1
+            if dt is None and len(node.args) > pos:
+                dt = self._dtype_arg(node.args[pos], depth)
+            shape = self._shape_arg(node.args[0], depth) if (
+                node.args
+            ) else None
+            return AbstractValue(dt or _CREATORS[base_name], shape, True)
+        if base_name in _LIKE_CREATORS:
+            v = self.value_of(node.args[0], depth + 1) if (
+                node.args
+            ) else ANY
+            dt = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = self._dtype_arg(kw.value, depth)
+            return AbstractValue(dt or v.dtype, v.shape, True)
+        if base_name == "arange":
+            dt = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = self._dtype_arg(kw.value, depth)
+            if dt is None and any(
+                isinstance(a, ast.Constant) and isinstance(a.value, float)
+                for a in node.args
+            ):
+                dt = "f32"
+            return AbstractValue(dt or "i32", None, True)
+        if base_name == "iota":
+            return AbstractValue("i32", None, True)
+        if base_name in _KEY_FNS and name.split(".")[0] in (
+            "jax", "random", "jr",
+        ) or (base_name in _KEY_FNS and "random" in name):
+            return KEY
+        if base_name in _RANDOM_FLOAT and "random" in name:
+            dt = None
+            for kw in node.keywords:
+                if kw.arg == "dtype":
+                    dt = self._dtype_arg(kw.value, depth)
+            return AbstractValue(dt or "f32", None, True)
+        if base_name == "categorical" and "random" in name:
+            return AbstractValue("i32", None, True)
+        if base_name == "bernoulli" and "random" in name:
+            return AbstractValue("bool", None, True)
+        if base_name in _ARG_FNS:
+            return AbstractValue("i32", None, True)
+        if base_name == "one_hot":
+            return AbstractValue("f32", None, True)
+        if base_name in _MATMULS:
+            for kw in node.keywords:
+                if kw.arg == "preferred_element_type":
+                    dt = self._dtype_arg(kw.value, depth)
+                    return AbstractValue(dt or "any", None, True)
+            ops = [
+                self.value_of(a, depth + 1)
+                for a in node.args
+                if not (
+                    isinstance(a, ast.Constant)
+                    and isinstance(a.value, str)
+                )
+            ]
+            dt = "bottom"
+            for v in ops:
+                dt = promote(dt, v.dtype) if dt != "bottom" else v.dtype
+            return AbstractValue(dt or "any", None, True)
+        if base_name in _PASSTHROUGH and node.args:
+            v = self.value_of(node.args[0], depth + 1)
+            if base_name == "where" and len(node.args) >= 3:
+                a = self.value_of(node.args[1], depth + 1)
+                b = self.value_of(node.args[2], depth + 1)
+                return AbstractValue(
+                    promote(a.dtype, b.dtype), None, True
+                )
+            return AbstractValue(v.dtype, None, v.array)
+        # ``.at[...].set/add/...(v)`` functional update: dtype of the
+        # base array (JAX casts the update operand INTO the buffer)
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(
+            f.value, ast.Subscript
+        ):
+            sub = f.value.value
+            if isinstance(sub, ast.Attribute) and sub.attr == "at":
+                return self.value_of(sub.value, depth + 1)
+        # package call: evaluate the callee's returns interprocedurally
+        resolved = self.tf.resolve(self.fn.module, self.fn, node)
+        if resolved:
+            return self.tf.return_value(resolved[0], node, self, depth)
+        return ANY
+
+
+class TypeFlow:
+    """Package-wide driver: the traced set plus lazy per-function
+    :class:`_FnTypes` environments."""
+
+    def __init__(self, modules: List[ModuleInfo], ctx):
+        t0 = time.perf_counter()
+        from cst_captioning_tpu.analysis import jit_boundary as jb
+
+        self.modules = modules
+        self.ctx = ctx
+        self.by_rel = {m.rel: m for m in modules}
+        traced = jb._TracedSet()
+        jb._collect_roots(modules, traced)
+        jb._expand(modules, ctx, traced)
+        self.traced = traced
+        self._du: Dict[Tuple[str, str], DefUse] = {}
+        self._fn_types: Dict[Tuple[str, str], _FnTypes] = {}
+        self._ret_memo: Dict[Tuple[str, str, Tuple[str, ...]], str] = {}
+        self.bucket_fn_names = self._bucket_fn_names()
+        self.duration_s = time.perf_counter() - t0
+
+    @staticmethod
+    def _bucket_fn_names() -> frozenset:
+        from cst_captioning_tpu.analysis import jit_registry
+
+        names = set()
+        for entry in jit_registry.SHAPE_LADDER_REGISTRY.values():
+            for fq in entry.bucket_fns:
+                names.add(fq.split("::")[-1].rsplit(".", 1)[-1])
+        return frozenset(names)
+
+    # --------------------------------------------------------- plumbing
+    def key(self, fn: FuncInfo) -> Tuple[str, str]:
+        return (fn.module.rel, fn.qualname)
+
+    def defuse(self, fn: FuncInfo) -> DefUse:
+        k = self.key(fn)
+        if k not in self._du:
+            self._du[k] = DefUse(fn)
+        return self._du[k]
+
+    def types_of(self, fn: FuncInfo) -> _FnTypes:
+        k = self.key(fn)
+        if k not in self._fn_types:
+            self._fn_types[k] = _FnTypes(self, fn)
+        return self._fn_types[k]
+
+    def resolve(self, mi: ModuleInfo, fn: FuncInfo, call: ast.Call):
+        return self.ctx.index.resolve_call(mi, fn, call)
+
+    def traced_functions(self) -> List[FuncInfo]:
+        out = []
+        for (rel, qn) in sorted(self.traced.static):
+            mi = self.by_rel.get(rel)
+            if mi is not None and qn in mi.functions:
+                out.append(mi.functions[qn])
+        return out
+
+    def param_value(self, fn: FuncInfo, name: str) -> AbstractValue:
+        """Traced parameters are TOP (unknown array) by construction —
+        a rule fires only on facts the flow actually proves."""
+        return ANY
+
+    # ------------------------------------------- interprocedural return
+    def return_value(
+        self, callee: FuncInfo, call: ast.Call,
+        caller_types: _FnTypes, depth: int,
+    ) -> AbstractValue:
+        if depth > 8:
+            return ANY
+        node = callee.node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return ANY
+        # argument dtype signature for memoization
+        sig = tuple(
+            caller_types.value_of(a, depth + 1).dtype for a in call.args
+        )
+        mk = (callee.module.rel, callee.qualname, sig)
+        if mk in self._ret_memo:
+            dt = self._ret_memo[mk]
+            return AbstractValue(dt, None, None if dt == "any" else True)
+        self._ret_memo[mk] = "any"          # recursion guard
+        callee_types = _CalleeTypes(self, callee, call, caller_types)
+        dt = "bottom"
+        for n in walk_body(callee):
+            if isinstance(n, ast.Return) and n.value is not None:
+                v = callee_types.value_of(n.value, depth + 1)
+                dt = v.dtype if dt == "bottom" else promote(dt, v.dtype)
+        if dt == "bottom":
+            dt = "any"
+        self._ret_memo[mk] = dt
+        return AbstractValue(dt, None, None if dt == "any" else True)
+
+
+class _CalleeTypes(_FnTypes):
+    """A callee evaluated under the caller's argument values: positional
+    and keyword args map onto parameters; everything else stays TOP."""
+
+    def __init__(
+        self, tf: TypeFlow, fn: FuncInfo, call: ast.Call,
+        caller: _FnTypes,
+    ):
+        super().__init__(tf, fn)
+        self._args: Dict[str, AbstractValue] = {}
+        params = [p for p in fn.params if p not in ("self", "cls")]
+        for p, a in zip(params, call.args):
+            self._args[p] = caller.value_of(a, 1)
+        for kw in call.keywords:
+            if kw.arg:
+                self._args[kw.arg] = caller.value_of(kw.value, 1)
+
+    def value_of(self, node: ast.AST, depth: int = 0) -> AbstractValue:
+        if isinstance(node, ast.Name) and node.id in self._args:
+            b = self.du.reaching_def(node)
+            if b is None or b.kind == "param":
+                return self._args[node.id]
+        return super().value_of(node, depth)
+
+
+# --------------------------------------------------------- cast surface
+
+def cast_sites(
+    modules: List[ModuleInfo], tf: TypeFlow
+) -> List[Tuple[str, ModuleInfo, FuncInfo, ast.Call, str]]:
+    """Every dtype-cast application inside the traced set, as
+    ``(registry_key, module, function, call, kind)`` — the surface
+    CST-DTY-001 audits against ``CAST_REGISTRY``."""
+    out = []
+    for fn in tf.traced_functions():
+        mi = fn.module
+        for node in walk_body(fn):
+            if isinstance(node, ast.Call):
+                kind = is_cast_call(node)
+                if kind is not None:
+                    out.append(
+                        (site_key(mi, fn.qualname), mi, fn, node, kind)
+                    )
+    return out
+
+
+# ------------------------------------------------------------ lifecycle
+
+_CACHE: List[Tuple[object, TypeFlow]] = []
+_LAST_DURATION = 0.0
+
+
+def build(modules: List[ModuleInfo], ctx) -> TypeFlow:
+    """Build (or reuse — both CST-DTY and CST-SHP ride one flow per
+    engine run) the TypeFlow for a scanned module list."""
+    global _LAST_DURATION
+    for obj, tf in _CACHE:
+        if obj is modules:
+            return tf
+    tf = TypeFlow(modules, ctx)
+    _CACHE.clear()
+    _CACHE.append((modules, tf))
+    _LAST_DURATION = tf.duration_s
+    return tf
+
+
+def note_duration(seconds: float) -> None:
+    """Accumulate checker wall time onto the current flow's total (the
+    interpretation itself is lazy, so the build alone undercounts)."""
+    global _LAST_DURATION
+    _LAST_DURATION += seconds
+
+
+def last_duration() -> float:
+    """Wall seconds the most recent typeflow pass took — traced-set
+    build plus the CST-DTY/CST-SHP interpretation on top (0.0 when the
+    engine served a cache hit and no flow ran) — the bench preflight
+    records this as ``analysis_typeflow_duration_s``."""
+    return _LAST_DURATION

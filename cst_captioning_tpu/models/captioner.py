@@ -247,7 +247,13 @@ class CaptionModel(nn.Module):
         vals, masks, means = [], [], []
         for i, m in enumerate(self.modalities):
             f = feats[m].astype(cdt)
-            v = f @ self.proj_w[i].astype(cdt) + self.proj_b[i].astype(cdt)
+            v = (
+                jnp.matmul(
+                    f, self.proj_w[i].astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+                + self.proj_b[i].astype(jnp.float32)
+            ).astype(cdt)
             fm = feat_masks[m].astype(jnp.float32)
             denom = jnp.maximum(fm.sum(-1, keepdims=True), 1.0)
             mean = (v.astype(jnp.float32) * fm[..., None]).sum(1) / denom
@@ -258,7 +264,13 @@ class CaptionModel(nn.Module):
         att_vals = jnp.concatenate(vals, axis=1)
         att_mask = jnp.concatenate(masks, axis=1)
         if self.fusion == "attention":
-            att_proj = att_vals @ self.att_wf.astype(cdt) + self.att_b.astype(cdt)
+            att_proj = (
+                jnp.matmul(
+                    att_vals, self.att_wf.astype(cdt),
+                    preferred_element_type=jnp.float32,
+                )
+                + self.att_b.astype(jnp.float32)
+            ).astype(cdt)
         else:
             att_proj = jnp.zeros(att_vals.shape[:2] + (0,), cdt)
         if self.use_category:
@@ -286,7 +298,12 @@ class CaptionModel(nn.Module):
         if self.fusion != "attention":
             return cache.ctx_static
         cdt = jnp.dtype(self.compute_dtype)
-        q = h_top.astype(cdt) @ self.att_wh.astype(cdt)  # (B, A)
+        # f32 accumulation pinned (CST-DTY-003): under a bf16 compute
+        # dtype the query GEMM must not accumulate in bf16.
+        q = jnp.matmul(
+            h_top.astype(cdt), self.att_wh.astype(cdt),
+            preferred_element_type=jnp.float32,
+        ).astype(cdt)  # (B, A)
         mesh = self.frame_mesh
         if (
             self.shard_frames
@@ -376,9 +393,12 @@ class CaptionModel(nn.Module):
 
     def _logits(self, h: jax.Array) -> jax.Array:
         cdt = jnp.dtype(self.compute_dtype)
-        return (
-            h.astype(cdt) @ self.logit_w.astype(cdt) + self.logit_b.astype(cdt)
-        ).astype(jnp.float32)
+        # The vocab GEMM accumulates f32 regardless of the compute
+        # dtype (CST-DTY-003) — decode scores exit f32 by contract.
+        return jnp.matmul(
+            h.astype(cdt), self.logit_w.astype(cdt),
+            preferred_element_type=jnp.float32,
+        ) + self.logit_b.astype(jnp.float32)
 
     @staticmethod
     def mask_decode_logits(

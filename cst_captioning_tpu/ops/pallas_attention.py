@@ -43,11 +43,19 @@ NEG_INF = -1e30
 def dense_context_attention(q, att_proj, att_mask, att_vals, att_v):
     """Reference XLA path — identical math to CaptionModel's inline
     version (kept here so kernel tests diff against one definition)."""
-    s = jnp.tanh(att_proj + q[:, None, :]) @ att_v
+    # Score + context mix accumulate f32 (CST-DTY-003), then round back
+    # to the value dtype — the kernel's own cast structure.
+    s = jnp.matmul(
+        jnp.tanh(att_proj + q[:, None, :]), att_v,
+        preferred_element_type=jnp.float32,
+    )
     s = s[..., 0].astype(jnp.float32)
     s = jnp.where(att_mask > 0, s, NEG_INF)
     a = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bf,bfe->be", a.astype(att_vals.dtype), att_vals)
+    return jnp.einsum(
+        "bf,bfe->be", a.astype(att_vals.dtype), att_vals,
+        preferred_element_type=jnp.float32,
+    ).astype(att_vals.dtype)
 
 
 def _pick_bt(B: int, cap: int = 32) -> Optional[int]:

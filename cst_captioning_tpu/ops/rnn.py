@@ -75,8 +75,13 @@ def lstm_step(
         w = weights.w.astype(compute_dtype)
     else:
         w = weights.w
-    gates = jnp.concatenate([x, h], axis=-1) @ w
-    gates = gates.astype(jnp.float32) + weights.b.astype(jnp.float32)
+    # f32 accumulation pinned (CST-DTY-003): the gate GEMM must not
+    # accumulate in a bf16 compute dtype.
+    gates = jnp.matmul(
+        jnp.concatenate([x, h], axis=-1), w,
+        preferred_element_type=jnp.float32,
+    )
+    gates = gates + weights.b.astype(jnp.float32)
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     c_new = jax.nn.sigmoid(f) * c.astype(jnp.float32) + jax.nn.sigmoid(i) * jnp.tanh(g)
     h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)

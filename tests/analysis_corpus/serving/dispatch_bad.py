@@ -1,0 +1,27 @@
+# corpus-rules: shapeflow
+"""Seeded CST-SHP-001 data-dependent-dimension violation: a device
+array created with a ``len(...)``-derived leading dim in serving
+dispatch code — one XLA compile per distinct queue depth the moment it
+meets a jit boundary.  The negative case routes the count through a
+ladder bucket function first (``bucket`` is a registered quantizer
+name), which launders the taint."""
+
+import jax.numpy as jnp
+
+
+def storm_dispatch(requests, width):
+    n = len(requests)
+    # the raw count becomes a device shape: a recompile storm
+    bad = jnp.zeros((n, width))  # expect: CST-SHP-001
+    return bad
+
+
+def laddered_dispatch(engine, requests, width):
+    # negative: the count is quantized onto the compiled ladder
+    b = engine.bucket(len(requests))
+    ok = jnp.zeros((b, width))
+    # negative: host-side numpy assembly never compiles
+    import numpy as np
+
+    host = np.zeros((len(requests), width))
+    return ok, host

@@ -1,0 +1,69 @@
+# corpus-rules: dtypeflow
+"""Seeded CST-DTY violations: an unregistered cast inside traced code
+(001), an implicit int-array x float-literal weak promotion (002),
+unpinned matmuls on a registered low-precision path (003 — the corpus
+test injects the ``low_precision=True`` CAST_REGISTRY entry for
+``registered_low_precision``), and a donated parameter cast inside the
+traced body (004).  Negative cases prove the rules stay quiet on
+registered casts, float-side literals, pinned matmuls, and
+un-donated casts."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def unregistered_cast(x):
+    # a precision change reachable from a jit root, with no
+    # CAST_REGISTRY entry saying which PARITY tier survives it
+    return x.astype(jnp.bfloat16)  # expect: CST-DTY-001
+
+
+@jax.jit
+def weak_promotion(logits):
+    tok = jnp.arange(8)
+    # the interpreter PROVES tok is an i32 array; the bare float
+    # literal silently floats it to the default float
+    bad = tok * 0.5  # expect: CST-DTY-002
+    # a second same-symbol violation: the baseline diff is count-aware
+    bad2 = 2.5 - tok  # expect: CST-DTY-002
+    # negative: float-array x literal keeps its dtype (weak rule)
+    ok = jnp.zeros((8,), jnp.float32) * 0.5
+    # negative: bool masks scaled by literals are idiomatic
+    mask = tok > 3
+    okm = mask * 1.0
+    return bad, ok, okm
+
+
+@jax.jit
+def registered_low_precision(x, w):
+    # the cast itself is registered (entry injected by the test) ...
+    xc = x.astype(jnp.bfloat16)
+    # ... but matmuls on a low-precision path must pin accumulation
+    bad_op = xc @ w  # expect: CST-DTY-003
+    bad_call = jnp.matmul(xc, w)  # expect: CST-DTY-003
+    good = jnp.matmul(xc, w, preferred_element_type=jnp.float32)
+    return bad_op + bad_call + good
+
+
+def donated_step(state, batch):
+    # dtype-cast of the donated buffer: XLA cannot alias mismatched
+    # widths, so donation is silently disabled
+    return state.astype(jnp.bfloat16) + batch  # expect: CST-DTY-001, CST-DTY-004
+
+
+donated = jax.jit(donated_step, donate_argnums=(0,))
+
+
+def undonated_step(state, batch):
+    # negative: same cast, nothing donated -> only DTY-001 territory,
+    # and this function is jitted with no donation kwargs
+    return state.astype(jnp.float32) + batch  # expect: CST-DTY-001
+
+
+undonated = jax.jit(undonated_step)
+
+
+def host_helper(x):
+    # negative: NOT reachable from any jit root -> no DTY-001
+    return x.astype("float64")

@@ -1,0 +1,98 @@
+# corpus-rules: shapeflow
+"""Seeded CST-SHP violations: jit sites with no SHAPE_LADDER_REGISTRY
+entry (001), AOT enumeration drift in a class shipping the artifact
+contract (002), and trace-time loop unrolls over ``.shape`` (003).
+The data-dependent-dimension half of 001 is seeded separately in
+``serving/dispatch_bad.py`` (the rule scopes itself to dispatch
+directories).  Negative cases: static-bound loops and a drift-free
+AOT pair stay quiet."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit  # expect: CST-SHP-001
+def unladdered_root(x):
+    return x + 1
+
+
+@jax.jit  # expect: CST-SHP-001
+def shape_unroll(x):
+    acc = jnp.zeros_like(x[0])
+    # unrolls at trace time, once per shape: a per-shape graph blowup
+    for t in range(x.shape[0]):  # expect: CST-SHP-003
+        acc = acc + x[t]
+    # negative: a small static bound is ordinary unrolling
+    for _ in range(4):
+        acc = acc * 1
+    n = x.shape[0]
+    # the read threads through the def-use chains too
+    while n > 0:  # expect: CST-SHP-003
+        acc = acc - 1
+        n = n - 1
+    return acc
+
+
+class DriftingArtifact:
+    """aot_variant_keys / aot_lower disagree on every axis the rule
+    checks: key families, builder coverage, ladder sources."""
+
+    def __init__(self):
+        self.bank_ladder = [8, 16]
+        self._fns = {}
+
+    def warm_admit_counts(self, bank):
+        return [0, bank]
+
+    def _tick_fn(self, a):
+        return self._fns.setdefault(("tick", a), object())
+
+    def _extra_fn(self, s):  # expect: CST-SHP-002
+        # a compiled-variant builder aot_lower never lowers
+        return self._fns.setdefault(("extra", s), object())
+
+    def warmup(self):
+        for bank in self.bank_ladder:
+            for a in self.warm_admit_counts(bank):
+                self._tick_fn(a)
+            self._extra_fn(bank)
+
+    # emits "free:" keys aot_lower never builds, and ignores the
+    # bank_ladder/warm_admit_counts sources warmup walks
+    def aot_variant_keys(self):  # expect: CST-SHP-002
+        return [f"tick:A{a}" for a in (0, 8)] + ["free:S8"]
+
+    def aot_lower(self):
+        return [(f"tick:A{a}", self._tick_fn(a)) for a in (0, 8)]
+
+
+class CleanArtifact:
+    """Negative: enumeration and builder agree — no findings."""
+
+    def __init__(self):
+        self.bank_ladder = [8]
+
+    def warm_admit_counts(self, bank):
+        return [0, bank]
+
+    def _tick_fn(self, a):
+        return object()
+
+    def warmup(self):
+        for bank in self.bank_ladder:
+            for a in self.warm_admit_counts(bank):
+                self._tick_fn(a)
+
+    def aot_variant_keys(self):
+        return [
+            f"tick:S{b}:A{a}"
+            for b in self.bank_ladder
+            for a in self.warm_admit_counts(b)
+        ]
+
+    def aot_lower(self):
+        return [
+            (f"tick:S{b}:A{a}", self._tick_fn(a))
+            for b in self.bank_ladder
+            for a in self.warm_admit_counts(b)
+        ]

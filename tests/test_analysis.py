@@ -49,6 +49,8 @@ _FAMILY_OF_PREFIX = {
     "CST-RNG": "rng",
     "CST-CFG": "configflow",
     "CST-EXC": "exceptions",
+    "CST-DTY": "dtypeflow",
+    "CST-SHP": "shapeflow",
 }
 
 
@@ -60,7 +62,14 @@ def _family(rule: str) -> str:
 
 class TestPackageClean:
     def test_zero_unsuppressed_findings_within_budget(self):
-        report = run_analysis(PACKAGE_ROOT)
+        # Cache-enabled (ISSUE 15): tier-1 gates on 0 findings without
+        # the bench preflight, and repeat suite runs on an unchanged
+        # tree pay milliseconds (the store is the same .analysis_cache
+        # bench uses; the key hashes every source, so a hit can never
+        # hide a finding).
+        report = run_analysis(
+            PACKAGE_ROOT, cache_dir=REPO / ".analysis_cache"
+        )
         assert report.clean, "\n" + report.render()
         assert report.duration_s < ANALYSIS_BUDGET_S, (
             f"analysis took {report.duration_s:.1f}s — over the "
@@ -427,9 +436,20 @@ class TestCorpus:
         ctx = CheckContext(
             index=PackageIndex(mods), package_root=CORPUS, docs_root=None
         )
+        from cst_captioning_tpu.analysis.jit_registry import (
+            CAST_REGISTRY,
+            CastSite,
+        )
+
         key = "donation_bad.py::make_bad_update_step::train_step"
         JIT_SITE_REGISTRY[key] = JitSite(
             "corpus-injected update step", update_step=True
+        )
+        # the CST-DTY-003 seeds live on a registered low-precision path
+        cast_key = "typeflow/dty_bad.py::registered_low_precision"
+        CAST_REGISTRY[cast_key] = CastSite(
+            "corpus", "corpus-injected low-precision path",
+            low_precision=True,
         )
         # configflow's doc-coverage rule (CST-CFG-003) runs against the
         # corpus's own docs twin; every other family runs doc-less.
@@ -445,6 +465,7 @@ class TestCorpus:
                 ))
         finally:
             del JIT_SITE_REGISTRY[key]
+            del CAST_REGISTRY[cast_key]
         return findings
 
     def test_every_seeded_violation_fires_exactly_its_rule(
@@ -1046,3 +1067,445 @@ class TestSuppressionExpiry:
         )
         assert rep.suppressed
         assert not rep.unused_suppressions
+
+
+# ------------------------------------- ISSUE 15: dtype/shape flow engine
+
+def _package_world():
+    mods = [
+        m for m in scan_package(PACKAGE_ROOT)
+        if not m.rel.startswith("analysis/")
+    ]
+    ctx = CheckContext(
+        index=PackageIndex(mods), package_root=PACKAGE_ROOT,
+        docs_root=None,
+    )
+    return mods, ctx
+
+
+@pytest.fixture(scope="module")
+def typeflow_world():
+    _load_checkers()
+    mods, ctx = _package_world()
+    from cst_captioning_tpu.analysis import typeflow as tfmod
+
+    return mods, ctx, tfmod.build(mods, ctx)
+
+
+class TestTypeflowGuards:
+    """Vacuous-green guards: the abstract interpreter must actually SEE
+    the real cast surface, the real jit-site ladder surface, and the
+    real AOT contract class — and prove real dtype facts — before its
+    0-findings package run means anything."""
+
+    def test_cast_surface_discovery(self, typeflow_world):
+        from cst_captioning_tpu.analysis.jit_registry import CAST_REGISTRY
+        from cst_captioning_tpu.analysis.typeflow import cast_sites
+
+        mods, ctx, tf = typeflow_world
+        sites = cast_sites(mods, tf)
+        keys = {k for k, *_ in sites}
+        # the real package's traced cast surface (39 sites / 140+ casts
+        # at ISSUE 15) — shrinking discovery must fail loudly
+        assert len(keys) >= 35, sorted(keys)
+        assert len(sites) >= 120
+        for expected in (
+            "decoding/core.py::decode_step",
+            "models/captioner.py::CaptionModel._logits",
+            "ops/rnn.py::lstm_step",
+            "ops/pallas_sampler.py::_gumbel_from_counter",
+            "serving/slots.py::SlotDecoder._tick_fn.tick",
+        ):
+            assert expected in keys
+        # and every discovered site is registered (the 0-findings run
+        # is coverage, not blindness)
+        assert keys <= set(CAST_REGISTRY)
+
+    def test_every_jit_site_has_a_shape_ladder(self, typeflow_world):
+        from cst_captioning_tpu.analysis.donation import collect_jit_sites
+        from cst_captioning_tpu.analysis.jit_registry import (
+            JIT_SITE_REGISTRY,
+            SHAPE_LADDER_REGISTRY,
+        )
+
+        mods, ctx, tf = typeflow_world
+        sites = collect_jit_sites(mods)
+        assert len(sites) >= 26          # the registered jit surface
+        keys = {k for k, *_ in sites}
+        assert keys == set(JIT_SITE_REGISTRY)
+        assert keys == set(SHAPE_LADDER_REGISTRY)
+        enumerated = {
+            k for k, e in SHAPE_LADDER_REGISTRY.items()
+            if e.kind == "enumerated"
+        }
+        # the serving ladder + slot bank grid + PG trim buckets
+        assert len(enumerated) >= 5
+        defined = {
+            f"{m.rel}::{qn}" for m in mods for qn in m.functions
+        }
+        for k in enumerated:
+            assert SHAPE_LADDER_REGISTRY[k].bucket_fns, k
+            for fq in SHAPE_LADDER_REGISTRY[k].bucket_fns:
+                assert fq in defined, f"{k} names dead bucket fn {fq}"
+
+    def test_aot_drift_checker_sees_slotdecoder(self, typeflow_world):
+        from cst_captioning_tpu.analysis.shapeflow import (
+            aot_contract_classes,
+        )
+
+        mods, ctx, tf = typeflow_world
+        found = {
+            (mi.rel, cls) for mi, cls, _ in aot_contract_classes(mods)
+        }
+        assert ("serving/slots.py", "SlotDecoder") in found
+        _, _, methods = next(
+            t for t in aot_contract_classes(mods)
+            if t[1] == "SlotDecoder"
+        )
+        # the three compiled-variant families the drift rule audits
+        assert {"_tick_fn", "_free_fn", "_resize_fn"} <= set(methods)
+
+    def test_interpreter_proves_f32_logits_exit(self, typeflow_world):
+        """The PARITY contract 'decode scores exit f32' is now a
+        dataflow FACT: the abstract value of _logits' return is f32
+        (matmul preferred_element_type + f32 bias promotion)."""
+        import ast as _ast
+
+        from cst_captioning_tpu.analysis.astutil import walk_body
+
+        mods, ctx, tf = typeflow_world
+        mi = next(m for m in mods if m.rel == "models/captioner.py")
+        fn = mi.functions["CaptionModel._logits"]
+        types = tf.types_of(fn)
+        ret = next(
+            n for n in walk_body(fn) if isinstance(n, _ast.Return)
+        )
+        v = types.value_of(ret.value)
+        assert v.dtype == "f32", v
+
+    def test_interpreter_proves_int_arrays(self, tmp_path):
+        """End-to-end dtype propagation on a synthetic root: arange →
+        i32, astype → bf16, weak literal does NOT widen."""
+        import ast as _ast
+
+        from cst_captioning_tpu.analysis import typeflow as tfmod
+        from cst_captioning_tpu.analysis.astutil import walk_body
+
+        (tmp_path / "m.py").write_text(
+            "import jax\nimport jax.numpy as jnp\n\n"
+            "@jax.jit\n"
+            "def f(x):\n"
+            "    tok = jnp.arange(8)\n"
+            "    half = jnp.zeros((4,), jnp.bfloat16) * 0.5\n"
+            "    down = tok.astype(jnp.int8)\n"
+            "    return tok, half, down\n"
+        )
+        mods = scan_package(tmp_path)
+        ctx = CheckContext(
+            index=PackageIndex(mods), package_root=tmp_path,
+            docs_root=None,
+        )
+        tf = tfmod.TypeFlow(mods, ctx)
+        fn = mods[0].functions["f"]
+        types = tf.types_of(fn)
+        vals = {}
+        for n in walk_body(fn):
+            if isinstance(n, _ast.Assign) and isinstance(
+                n.targets[0], _ast.Name
+            ):
+                vals[n.targets[0].id] = types.value_of(n.value)
+        assert vals["tok"].dtype == "i32" and vals["tok"].array
+        assert vals["half"].dtype == "bf16"     # weak 0.5 can't widen
+        assert vals["down"].dtype == "i8"
+
+    def test_promotion_lattice_weak_rules(self):
+        from cst_captioning_tpu.analysis.typeflow import promote
+
+        assert promote("bf16", "wf") == "bf16"   # scalar never widens
+        assert promote("i32", "wf") == "f32"     # ...but floats ints
+        assert promote("i32", "wi") == "i32"
+        assert promote("bf16", "f16") == "f32"   # jax's odd couple
+        assert promote("bf16", "f32") == "f32"
+        assert promote("any", "f32") == "any"    # top absorbs
+
+    def test_low_precision_surface_stays_declared(self):
+        """The compute-dtype paths must keep their low_precision flag —
+        flipping one off silently exempts its matmuls from the
+        CST-DTY-003 accumulation pin."""
+        from cst_captioning_tpu.analysis.jit_registry import CAST_REGISTRY
+
+        for key in (
+            "models/captioner.py::CaptionModel._logits",
+            "models/captioner.py::CaptionModel._encode",
+            "ops/rnn.py::lstm_step",
+            "ops/pallas_attention.py::dense_context_attention",
+            "ops/shard_decode.py::_local_logits",
+            "ops/pallas_beam.py::_make_beam_kernel.kernel",
+        ):
+            assert CAST_REGISTRY[key].low_precision, key
+
+
+class TestTypeflowRegistryFaults:
+    """The acceptance bar: removing any single CAST_REGISTRY /
+    SHAPE_LADDER_REGISTRY entry fails the pass at the exact
+    file:line."""
+
+    def _run(self, family, mods, ctx):
+        return CHECKERS[family](mods, ctx)
+
+    def test_unregistering_a_cast_site_fires_dty001(
+        self, monkeypatch, typeflow_world
+    ):
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        mods, ctx, tf = typeflow_world
+        key = "decoding/core.py::decode_step"
+        monkeypatch.delitem(jr.CAST_REGISTRY, key)
+        hits = [
+            f for f in self._run("dtypeflow", mods, ctx)
+            if f.rule == "CST-DTY-001" and f.file == "decoding/core.py"
+        ]
+        assert len(hits) == 1
+        src = (PACKAGE_ROOT / "decoding/core.py").read_text().splitlines()
+        assert "astype" in src[hits[0].line - 1]
+
+    def test_stale_cast_entry_fires_dty001(
+        self, monkeypatch, typeflow_world
+    ):
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        mods, ctx, tf = typeflow_world
+        monkeypatch.setitem(
+            jr.CAST_REGISTRY,
+            "decoding/core.py::no_such_function",
+            jr.CastSite("token-exact", "stale"),
+        )
+        hits = [
+            f for f in self._run("dtypeflow", mods, ctx)
+            if f.rule == "CST-DTY-001"
+            and "stale" in f.message
+            and f.symbol == "decoding/core.py::no_such_function"
+        ]
+        assert len(hits) == 1
+        assert hits[0].file == "analysis/jit_registry.py"
+
+    def test_unregistering_a_shape_ladder_fires_shp001(
+        self, monkeypatch, typeflow_world
+    ):
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        mods, ctx, tf = typeflow_world
+        key = "serving/slots.py::SlotDecoder._tick_fn.tick"
+        monkeypatch.delitem(jr.SHAPE_LADDER_REGISTRY, key)
+        hits = [
+            f for f in self._run("shapeflow", mods, ctx)
+            if f.rule == "CST-SHP-001" and f.file == "serving/slots.py"
+        ]
+        assert len(hits) == 1
+        src = (PACKAGE_ROOT / "serving/slots.py").read_text().splitlines()
+        window = src[hits[0].line - 1] + src[hits[0].line]
+        assert "jit" in window
+
+    def test_stale_ladder_entry_fires_shp001(
+        self, monkeypatch, typeflow_world
+    ):
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        mods, ctx, tf = typeflow_world
+        monkeypatch.setitem(
+            jr.SHAPE_LADDER_REGISTRY,
+            "serving/slots.py::no_such_site",
+            jr.ShapeLadder("fixed", "stale"),
+        )
+        hits = [
+            f for f in self._run("shapeflow", mods, ctx)
+            if f.rule == "CST-SHP-001" and "stale" in f.message
+        ]
+        assert [f.symbol for f in hits] == [
+            "serving/slots.py::no_such_site"
+        ]
+
+    def test_dead_bucket_fn_fires_shp001(
+        self, monkeypatch, typeflow_world
+    ):
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        mods, ctx, tf = typeflow_world
+        key = "serving/slots.py::SlotDecoder._free_fn.free_rows"
+        old = jr.SHAPE_LADDER_REGISTRY[key]
+        monkeypatch.setitem(
+            jr.SHAPE_LADDER_REGISTRY, key,
+            old._replace(
+                bucket_fns=("serving/slots.py::_renamed_ladder",)
+            ),
+        )
+        hits = [
+            f for f in self._run("shapeflow", mods, ctx)
+            if f.rule == "CST-SHP-001" and "no live def" in f.message
+        ]
+        assert len(hits) == 1 and hits[0].symbol == key
+
+
+class TestBaselineCLI:
+    """--baseline / --fail-on-new semantics (ISSUE 15): a committed
+    baseline absorbs known findings, the gate trips only on new ones,
+    and a malformed baseline refuses loudly."""
+
+    def _run(self, *args, env=None):
+        import os
+
+        e = dict(os.environ)
+        e["JAX_PLATFORMS"] = "cpu"
+        if env:
+            e.update(env)
+        return subprocess.run(
+            [sys.executable, "-m", "cst_captioning_tpu.analysis", *args],
+            capture_output=True, text=True, cwd=str(REPO), env=e,
+            timeout=120,
+        )
+
+    def _corpus_json(self):
+        proc = self._run(
+            "--json", "--root", str(CORPUS), "--rules", "dtypeflow"
+        )
+        assert proc.returncode == 1          # corpus seeds findings
+        return json.loads(proc.stdout)
+
+    def test_baseline_absorbs_known_findings(self, tmp_path):
+        rec = self._corpus_json()
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps(rec))
+        # fail-on-new: everything known -> exit 0
+        p = self._run(
+            "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(base), "--fail-on-new",
+        )
+        assert p.returncode == 0, p.stdout + p.stderr
+        assert "0 new" in p.stdout
+        # without --fail-on-new the baseline only annotates: the old
+        # findings still gate (exit 1)
+        p2 = self._run(
+            "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(base),
+        )
+        assert p2.returncode == 1
+        assert "0 new" in p2.stdout
+
+    def test_new_finding_trips_the_gate(self, tmp_path):
+        rec = self._corpus_json()
+        assert len(rec["findings"]) >= 2
+        dropped = rec["findings"].pop(0)     # one triple becomes NEW
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps(rec))
+        p = self._run(
+            "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(base), "--fail-on-new",
+        )
+        assert p.returncode == 1
+        assert "NEW:" in p.stdout
+        assert "1 new" in p.stdout
+        assert dropped["rule"] in p.stdout
+
+    def test_json_mode_carries_new_findings(self, tmp_path):
+        rec = self._corpus_json()
+        rec["findings"] = rec["findings"][1:]
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps(rec))
+        p = self._run(
+            "--json", "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(base), "--fail-on-new",
+        )
+        assert p.returncode == 1
+        out = json.loads(p.stdout)
+        validate_report(out)
+        assert len(out["new_findings"]) == 1
+
+    def test_baseline_is_count_aware(self, tmp_path):
+        """Two same-triple findings against ONE baseline entry: one is
+        absorbed, the second is new (a regression that adds a second
+        violation to an already-dirty symbol still trips)."""
+        rec = self._corpus_json()
+        trip_counts = {}
+        for f in rec["findings"]:
+            k = (f["rule"], f["file"], f["symbol"])
+            trip_counts[k] = trip_counts.get(k, 0) + 1
+        dup = next(
+            (k for k, n in trip_counts.items() if n >= 2), None
+        )
+        assert dup is not None, (
+            "corpus must seed a symbol with two same-rule findings "
+            "(registered_low_precision's two unpinned matmuls)"
+        )
+        kept = []
+        skipped = False
+        for f in rec["findings"]:
+            if not skipped and (
+                f["rule"], f["file"], f["symbol"]
+            ) == dup:
+                skipped = True
+                continue
+            kept.append(f)
+        rec["findings"] = kept
+        base = tmp_path / "baseline.json"
+        base.write_text(json.dumps(rec))
+        p = self._run(
+            "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(base), "--fail-on-new",
+        )
+        assert p.returncode == 1
+        assert "1 new" in p.stdout
+
+    def test_malformed_baseline_exits_two(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("{not json")
+        p = self._run(
+            "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(bad), "--fail-on-new",
+        )
+        assert p.returncode == 2
+        assert "unreadable" in p.stderr
+        bad.write_text(json.dumps({"findings": [{"rule": 3}]}))
+        p2 = self._run(
+            "--root", str(CORPUS), "--rules", "dtypeflow",
+            "--baseline", str(bad), "--fail-on-new",
+        )
+        assert p2.returncode == 2
+        assert "malformed" in p2.stderr
+
+    def test_fail_on_new_requires_baseline(self):
+        p = self._run("--fail-on-new")
+        assert p.returncode == 2
+        assert "--baseline" in p.stderr
+
+
+class TestTypeflowSarif:
+    def test_sarif_export_includes_the_new_rules(self):
+        """ISSUE 15 satellite: the corpus SARIF carries CST-DTY and
+        CST-SHP driver rules (the scanning UIs discover them there)."""
+        from cst_captioning_tpu.analysis.sarif import (
+            to_sarif,
+            validate_sarif,
+        )
+
+        _load_checkers()
+        mods = scan_package(CORPUS)
+        ctx = CheckContext(
+            index=PackageIndex(mods), package_root=CORPUS,
+            docs_root=None,
+        )
+        findings = []
+        for name in ("dtypeflow", "shapeflow"):
+            findings.extend(CHECKERS[name](mods, ctx))
+        from cst_captioning_tpu.analysis.engine import Report
+
+        rep = Report(
+            findings=findings, suppressed=[], unused_suppressions=[],
+            rules_run=["dtypeflow", "shapeflow"],
+            files_scanned=len(mods), duration_s=0.1,
+        )
+        doc = validate_sarif(to_sarif(rep.to_dict()))
+        ids = {
+            r["id"] for r in doc["runs"][0]["tool"]["driver"]["rules"]
+        }
+        assert {"CST-DTY-001", "CST-DTY-002", "CST-DTY-004"} <= ids
+        assert {"CST-SHP-001", "CST-SHP-002", "CST-SHP-003"} <= ids

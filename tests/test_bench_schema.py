@@ -483,6 +483,9 @@ class TestAnalysisReportSchema:
     @pytest.mark.parametrize("key", [
         "analysis_rules_active", "analysis_cache_hit_files",
         "analysis_findings",
+        # ISSUE 15: the typeflow preflight provenance rides the same
+        # numeric contract — family count and interpreter wall time.
+        "analysis_families_active", "analysis_typeflow_duration_s",
     ])
     @pytest.mark.parametrize("bad", [True, False, None, "11", [3]])
     def test_analysis_extras_must_be_numeric(self, key, bad):
@@ -493,6 +496,8 @@ class TestAnalysisReportSchema:
         rec["extra"]["analysis_rules_active"] = 11
         rec["extra"]["analysis_cache_hit_files"] = 70
         rec["extra"]["analysis_findings"] = 0
+        rec["extra"]["analysis_families_active"] = 13
+        rec["extra"]["analysis_typeflow_duration_s"] = 0.41
         validate_record(rec)                 # numeric: fine
         rec["extra"][key] = bad
         with pytest.raises(ValueError, match=key):
