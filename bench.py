@@ -210,6 +210,28 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
                 continue
             if not _is_number(v):
                 fail(f"{k!r} must be a real number, got {v!r}")
+        # Low-precision serving rows (ISSUE 16): every lowprec_* field
+        # is a measurement by contract — numeric, never bool/None/
+        # prose (the f32/bf16/int8w triple is only comparable when all
+        # three arms really decoded at matched load), except the
+        # provenance string fields which keep their own formats.  Any
+        # *match_rate* field is a caption-match FRACTION in [0, 1]:
+        # the relaxed-serving parity gate compares it against the
+        # pinned floor before the row is ever emitted, and a value
+        # outside the unit interval means the match counting is wrong.
+        for k, v in rec["extra"].items():
+            if not k.startswith("lowprec_"):
+                continue
+            if k.endswith(("_mesh_shape", "_xla_flags",
+                           "_jax_platforms")):
+                continue
+            if not _is_number(v):
+                fail(f"{k!r} must be a real number, got {v!r}")
+            if "match_rate" in k and not (0.0 <= v <= 1.0):
+                fail(
+                    f"{k!r} must be a caption-match fraction in "
+                    f"[0, 1], got {v!r}"
+                )
         # Mesh topology is a machine-readable string by contract
         # (ISSUE 9): any *_mesh_shape field must look like "2x4" —
         # axis sizes joined by "x" in declared axis order.  A bool,
@@ -2891,6 +2913,252 @@ def bench_shard_fused(backend_ok: bool = True):
     return out
 
 
+def _bench_lowprec_impl():
+    """Paired f32/bf16/int8w serving rows at matched offered load (the
+    in-process child of :func:`bench_lowprec`; ISSUE 16).
+
+    One random init, one fixed payload set, three engines per grid —
+    ``serving.dtype`` in f32/bf16/int8w on the 1-device placement and
+    the (1, 2) tensor-parallel submesh.  The relaxed-serving parity
+    contract is ASSERTED before anything is recorded: caption-match
+    rate vs the f32 arm >= RELAXED_SERVING_MATCH_FLOOR and per-caption
+    beam-score gap <= RELAXED_SERVING_SCORE_RTOL
+    (analysis/jit_registry.py; docs/PARITY.md r17) — perf for wrong
+    captions must never ship.  Weight residency is recorded both ways:
+    the closed-form vocab-tile arithmetic (``quantized_leaf_bytes`` —
+    the int8 payload is EXACTLY 0.25x the f32 tile, asserted) and the
+    measured per-shard resident bytes (``param_bytes_per_shard``).
+    Virtual-CPU captions/s are not TPU captions/s; the honest
+    ``lowprec_host_cores``/``*_mesh_shape`` provenance keeps the rows
+    caveated from the record alone."""
+    import copy
+
+    from cst_captioning_tpu.analysis.jit_registry import (
+        RELAXED_SERVING_MATCH_FLOOR,
+        RELAXED_SERVING_SCORE_RTOL,
+    )
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.build import build_dataset
+    from cst_captioning_tpu.decoding.beam import make_beam_search_fn
+    from cst_captioning_tpu.ops import quant
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    n = len(jax.devices())
+    if n < 2:
+        raise RuntimeError(
+            f"lowprec TP arm needs >=2 virtual devices, have {n}"
+        )
+    V = int(os.environ.get("BENCH_LOWPREC_VOCAB", "2048"))
+    rounds = int(os.environ.get("BENCH_LOWPREC_ROUNDS", "6"))
+    B = int(os.environ.get("BENCH_LOWPREC_BATCH", "8"))
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.max_batch_size = B
+    cfg.serving.batch_shapes = [B]
+    cfg.eval.beam_size = 3
+    cfg.eval.max_decode_len = 12
+    ds, vocab = build_dataset(cfg, cfg.eval.eval_split)
+    # Even vocab tile over the 2-way model axis; extra rows beyond the
+    # real vocabulary are legal (random-init captions either way).
+    cfg.model.vocab_size = max(V, (len(vocab) + 1) // 2 * 2) // 2 * 2
+    base = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    payloads = [
+        {"features": {m: a.tolist() for m, a in ds.features(i).items()}}
+        for i in range(B)
+    ]
+
+    def build(dtype, model_shards=1):
+        c = copy.deepcopy(cfg)
+        c.serving.dtype = dtype
+        c.serving.model_shards = model_shards
+        c.serving.replicas = 1
+        # base.params are float: the int8w ctor quantizes them ONCE at
+        # boot, so every arm serves the same logical weights.
+        return InferenceEngine(c, params=base.params, vocab=base.vocab)
+
+    def measure(eng):
+        reqs = [eng.prepare(dict(p)) for p in payloads]
+        caps = [r.caption for r in eng.decode_prepared(reqs, store=False)]
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            out = eng.decode_prepared(reqs, store=False)
+            times.append(time.perf_counter() - t0)
+        assert [r.caption for r in out] == caps  # steady-state decode
+        times.sort()
+        p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+        return {
+            "captions": caps,
+            "captions_per_sec": len(reqs) * rounds / sum(times),
+            "p99_batch_ms": p99 * 1e3,
+            "bytes_per_shard": eng.param_bytes_per_shard(),
+            "mesh_shape": eng.describe()["mesh_shape"],
+        }
+
+    def scores(eng):
+        reqs = [eng.prepare(dict(p)) for p in payloads]
+        feats = {
+            m: jnp.asarray(np.stack([r.feats[m] for r in reqs]))
+            for m in reqs[0].feats
+        }
+        masks = {
+            m: jnp.asarray(np.stack([r.masks[m] for r in reqs]))
+            for m in reqs[0].masks
+        }
+        fn = make_beam_search_fn(
+            eng.model,
+            beam_size=cfg.eval.beam_size,
+            max_len=cfg.eval.max_decode_len,
+            length_normalize=cfg.eval.length_normalize,
+        )
+        return np.asarray(
+            fn(eng.params, feats, masks).score, np.float64
+        )
+
+    arms = {d: measure(build(d)) for d in ("f32", "bf16", "int8w")}
+    tp = {d: measure(build(d, 2)) for d in ("f32", "bf16", "int8w")}
+    eng_by_dtype = {d: build(d) for d in ("bf16", "int8w")}
+    f32_eng = build("f32")
+    s_ref = scores(f32_eng)
+
+    # ---- the relaxed-serving gate: parity BEFORE perf is recorded
+    parity = {}
+    for d in ("bf16", "int8w"):
+        ref, got = arms["f32"]["captions"], arms[d]["captions"]
+        match = sum(a == b for a, b in zip(ref, got)) / len(ref)
+        if match < RELAXED_SERVING_MATCH_FLOOR:
+            raise RuntimeError(
+                f"{d} caption-match rate {match:.3f} below the pinned "
+                f"relaxed-serving floor {RELAXED_SERVING_MATCH_FLOOR} "
+                "— do not record perf for out-of-contract captions"
+            )
+        s_low = scores(eng_by_dtype[d])
+        gap = float(np.max(
+            np.abs(s_low - s_ref) / np.maximum(np.abs(s_ref), 1e-6)
+        ))
+        if gap > RELAXED_SERVING_SCORE_RTOL:
+            raise RuntimeError(
+                f"{d} per-caption score gap {gap:.4f} above the pinned "
+                f"relaxed-serving rtol {RELAXED_SERVING_SCORE_RTOL}"
+            )
+        tp_match = sum(
+            a == b for a, b in zip(got, tp[d]["captions"])
+        ) / len(got)
+        if tp_match < RELAXED_SERVING_MATCH_FLOOR:
+            raise RuntimeError(
+                f"{d} TP=2 captions diverged from the 1-device arm "
+                f"(match {tp_match:.3f})"
+            )
+        parity[d] = {"match": match, "gap": gap, "tp_match": tp_match}
+
+    # ---- closed-form vocab-tile bytes vs measured residency
+    H = cfg.model.rnn_size
+    Vp = cfg.model.vocab_size
+    f32_tile = H * Vp * 4                       # logit_w, f32
+    int8_tile, scale_bytes = quant.quantized_leaf_bytes((H, Vp), 1)
+    if int8_tile * 4 != f32_tile:
+        raise RuntimeError(
+            f"int8w vocab tile {int8_tile} B is not exactly 0.25x the "
+            f"f32 tile {f32_tile} B — the closed form drifted"
+        )
+    p = eng_by_dtype["int8w"].params
+    p = p["params"] if "params" in p else p
+    measured_tile = int(np.asarray(p["logit_w"]).nbytes)
+    if measured_tile != int8_tile:
+        raise RuntimeError(
+            f"measured int8 logit_w bytes {measured_tile} != closed "
+            f"form {int8_tile} — the byte accounting is dishonest"
+        )
+
+    out = {
+        "lowprec_virtual_devices": n,
+        "lowprec_host_cores": float(os.cpu_count() or 1),
+        "lowprec_xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "lowprec_jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "lowprec_mesh_shape": tp["f32"]["mesh_shape"],
+        "lowprec_vocab": Vp,
+        "lowprec_beam": cfg.eval.beam_size,
+        "lowprec_batch": B,
+        "lowprec_match_floor": RELAXED_SERVING_MATCH_FLOOR,
+        "lowprec_score_rtol": RELAXED_SERVING_SCORE_RTOL,
+        # Closed-form vocab tile (logit_w): int8 is EXACTLY 0.25x f32;
+        # the per-channel scales are the honest small print.
+        "lowprec_vocab_tile_f32_bytes": f32_tile,
+        "lowprec_vocab_tile_int8w_bytes": int8_tile,
+        "lowprec_vocab_tile_scale_bytes": scale_bytes,
+        "lowprec_vocab_tile_ratio": round(int8_tile / f32_tile, 6),
+        "lowprec_vocab_tile_measured_bytes": measured_tile,
+    }
+    for d in ("f32", "bf16", "int8w"):
+        out[f"lowprec_{d}_captions_per_sec"] = round(
+            arms[d]["captions_per_sec"], 3
+        )
+        out[f"lowprec_{d}_p99_batch_ms"] = round(
+            arms[d]["p99_batch_ms"], 2
+        )
+        out[f"lowprec_{d}_param_bytes_per_shard"] = arms[d][
+            "bytes_per_shard"
+        ]
+        out[f"lowprec_{d}_tp2_captions_per_sec"] = round(
+            tp[d]["captions_per_sec"], 3
+        )
+        out[f"lowprec_{d}_tp2_param_bytes_per_shard"] = tp[d][
+            "bytes_per_shard"
+        ]
+    for d, pv in parity.items():
+        out[f"lowprec_{d}_match_rate"] = round(pv["match"], 4)
+        out[f"lowprec_{d}_score_gap_max"] = round(pv["gap"], 6)
+        out[f"lowprec_{d}_tp2_match_rate"] = round(pv["tp_match"], 4)
+        out[f"lowprec_{d}_vs_f32_ratio"] = round(
+            arms[d]["captions_per_sec"] / arms["f32"]["captions_per_sec"],
+            4,
+        )
+    return out
+
+
+def bench_lowprec(backend_ok: bool = True):
+    """Paired f32/bf16/int8w serving rows (see
+    :func:`_bench_lowprec_impl`).  Runs inline on a >=2-device host,
+    otherwise re-execs onto a virtual 2-device CPU platform so the
+    TP=2 arm shards a real mesh."""
+    import subprocess
+
+    if backend_ok:
+        try:
+            if len(jax.devices()) >= 2:
+                return _bench_lowprec_impl()
+        except Exception:  # noqa: BLE001 — fall through to the child
+            pass
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_LOWPREC_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"lowprec pair child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    out = json.loads(lines[-1])
+    out["lowprec_virtual_cpu"] = 1
+    return out
+
+
 def bench_loader():
     """Host batch assembly from the packed feature store at MSR-VTT shape
     (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
@@ -3345,6 +3613,17 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["shard_fused_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if os.environ.get("BENCH_LOWPREC", "1") == "1":
+        # Paired f32/bf16/int8w serving rows (ISSUE 16): captions/s +
+        # p99 + per-shard weight bytes at matched offered load on the
+        # 1-device and TP=2 grids, with the relaxed-serving parity
+        # bounds (caption-match floor, score-gap rtol) asserted BEFORE
+        # anything records.
+        try:
+            extra.update(bench_lowprec(backend_ok=ok))
+        except Exception as e:  # noqa: BLE001
+            extra["lowprec_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if os.environ.get("BENCH_LOADER", "1") == "1":
         # Host-only bench: runs even when the device backend is down.
         try:
@@ -3451,6 +3730,12 @@ if __name__ == "__main__":
         # (bench_shard_fused), same virtual-platform discipline.
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_shard_fused_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_LOWPREC_CHILD") == "1":
+        # Re-exec'd f32/bf16/int8w low-precision serving child
+        # (bench_lowprec), same virtual-platform discipline.
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_lowprec_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_REPLICA_CHILD") == "1":
         # Re-exec'd replica-sweep child (bench_serving_replicas): the

@@ -85,12 +85,27 @@ def _check_cast_registry(
     scanned = {m.rel for m in modules}
     for key in sorted(jit_registry.CAST_REGISTRY):
         rel = key.split("::", 1)[0]
-        if rel in scanned and key not in seen:
+        if rel not in scanned:
+            continue
+        if key not in seen:
             out.append(Finding(
                 "CST-DTY-001", "analysis/jit_registry.py", 1, key,
                 f"stale CAST_REGISTRY entry `{key}` matches no "
                 "traced cast site — the code moved; update or remove "
                 "the entry",
+            ))
+        tier = jit_registry.CAST_REGISTRY[key].tier
+        if tier not in jit_registry.PARITY_TIERS:
+            # Tier-vocabulary legality (ISSUE 16): an entry naming a
+            # tier docs/PARITY.md doesn't define claims a guarantee
+            # nothing enforces — a typo'd "token-exact" would
+            # otherwise pass review as a real contract.
+            out.append(Finding(
+                "CST-DTY-001", "analysis/jit_registry.py", 1, key,
+                f"CAST_REGISTRY entry `{key}` names illegal parity "
+                f"tier {tier!r} — legal tiers are "
+                f"{sorted(jit_registry.PARITY_TIERS)} "
+                "(jit_registry.PARITY_TIERS; docs/PARITY.md r17)",
             ))
     return out
 

@@ -428,6 +428,21 @@ class ServingConfig:
     # artifact garbage-collects older version directories beyond this
     # count — the ACTIVE (just-loaded) version is never collected.
     artifact_keep: int = 2
+    # Low-precision serving fast path (ops/quant.py; docs/SERVING.md
+    # "Low-precision serving").  "f32" = byte-identical to the engine
+    # before this knob existed (the model's own compute_dtype rules).
+    # "bf16" forces bfloat16 activations (f32 accumulation stays pinned
+    # per CST-DTY-003; decode decisions stay f32).  "int8w" additionally
+    # quantizes the big GEMM weights — vocab projection, embedding rows,
+    # LSTM kernels, attention MLP — to int8 with per-channel f32 scales,
+    # computed ONCE at engine boot or AOT artifact build; activations
+    # run bf16 and every decode DECISION (top-K keys, argmax, Gumbel
+    # race) still consumes f32 logits.  Rounding can move tokens: the
+    # parity contract is the `relaxed-serving` tier (caption-match rate
+    # vs f32 >= the pinned floor, per-caption score gap <= the pinned
+    # rtol — analysis/jit_registry.py constants, docs/PARITY.md r17).
+    # Serving-only: the trainer never reads this knob.
+    dtype: str = "f32"
     warmup: bool = True           # pre-jit the whole ladder at startup
 
 
@@ -647,6 +662,22 @@ def _preset_msrvtt_serve_grid() -> Config:
     return c
 
 
+def _preset_msrvtt_serve_int8w() -> Config:
+    """Low-precision serving: the TP2 grid with int8 weight-only
+    quantization of the vocab/embedding/LSTM/attention GEMM weights
+    (serving.dtype=int8w, ops/quant.py).  Per-device vocab-tile weight
+    bytes drop to ~0.25x the f32 TP2 engine (int8 codes; the per-channel
+    f32 scales shard with their columns), activations run bf16, decode
+    decisions stay f32.  Parity is the `relaxed-serving` tier: caption-
+    match floor + score-gap rtol vs f32 on the fixed eval set
+    (docs/PARITY.md r17; the lowprec_* bench rows assert it before
+    recording)."""
+    c = _preset_msrvtt_serve_tp()
+    c.name = "msrvtt_serve_int8w_tp2"
+    c.serving.dtype = "int8w"
+    return c
+
+
 def _preset_synthetic_smoke() -> Config:
     """CPU-runnable synthetic tiny config (tests / CI / integration)."""
     c = Config(name="synthetic_smoke")
@@ -691,6 +722,7 @@ PRESETS = {
     "msrvtt_xe_2d": _preset_msrvtt_xe_2d,
     "msrvtt_serve_tp2": _preset_msrvtt_serve_tp,
     "msrvtt_serve_r2xtp2": _preset_msrvtt_serve_grid,
+    "msrvtt_serve_int8w_tp2": _preset_msrvtt_serve_int8w,
     "synthetic_smoke": _preset_synthetic_smoke,
 }
 

@@ -50,6 +50,7 @@ from cst_captioning_tpu.decoding.core import (  # noqa: F401  (re-exported)
     decode_step,
     init_core,
 )
+from cst_captioning_tpu.ops.quant import dequant_rows, quant_matmul
 from cst_captioning_tpu.ops.rnn import (
     LSTMWeights,
     lstm_bias_init,
@@ -133,6 +134,17 @@ class CaptionModel(nn.Module):
     category_embed_size: int = 64
     compute_dtype: str = "bfloat16"
     param_dtype: str = "float32"
+    # int8 weight-only serving fast path (serving.dtype=int8w; ops/quant.py).
+    # When set, the large GEMM weights (word_embed, logit_w, lstm*_w,
+    # att_wf/att_wh) are EXPECTED to arrive as int8 codes with per-channel
+    # float32 `<name>_scale` sibling leaves (declared in setup below, filled
+    # by quant.quantize_params at engine boot or artifact build), and the
+    # cdt-surface methods (_encode/_context/_step/_logits) apply them via
+    # the scale-after-f32-accumulation helpers.  Decisions stay f32; parity
+    # is the `relaxed-serving` tier (analysis/jit_registry.py).  Fresh
+    # `init` still produces float weights + ones scales — the quant branch
+    # is numerically the bf16 path until quantize_params runs.
+    weight_quant: bool = False
     use_pallas: bool = False      # fused LSTM recurrence kernel fast path
     use_pallas_attention: bool = False  # fused Bahdanau attention step kernel
     # Whole-recurrence fused SAMPLER kernel (ops/pallas_sampler.py): the
@@ -178,6 +190,21 @@ class CaptionModel(nn.Module):
     # ---------------------------------------------------------------- setup
     def setup(self):
         assert len(self.modalities) == len(self.feature_dims)
+        if self.weight_quant and (
+            self.use_pallas
+            or self.use_pallas_attention
+            or self.use_pallas_sampler
+            or self.use_pallas_beam
+        ):
+            # The fused kernels stream raw float weight tiles; under
+            # weight_quant the kernel tiles would be int8 codes read as
+            # floats.  model_from_config gates these off with a logged
+            # decline — reaching here means a hand-built model skipped it.
+            raise ValueError(
+                "weight_quant (serving.dtype=int8w) is incompatible with "
+                "the fused Pallas kernel paths — they read raw weight "
+                "tiles; build via model_from_config, which declines them"
+            )
         pdt = jnp.dtype(self.param_dtype)
         E, H, A, V = (
             self.embed_size,
@@ -228,6 +255,31 @@ class CaptionModel(nn.Module):
             "logit_w", nn.initializers.glorot_uniform(), (H, V), pdt
         )
         self.logit_b = self.param("logit_b", nn.initializers.zeros_init(), (V,), pdt)
+        if self.weight_quant:
+            # Per-channel dequant scales for the int8 serving path —
+            # ordinary param leaves (always float32, whatever param_dtype
+            # says) so they checkpoint, shard (parallel/partition.py pins
+            # each to its weight's spec), and fingerprint like weights.
+            # Ones at init: quant.quantize_params overwrites them together
+            # with the int8 codes at engine boot / artifact build.
+            ones = nn.initializers.ones_init()
+            self.word_embed_scale = self.param(
+                "word_embed_scale", ones, (V,), jnp.float32
+            )
+            self.logit_w_scale = self.param(
+                "logit_w_scale", ones, (V,), jnp.float32
+            )
+            self.lstm_scales = [
+                self.param(f"lstm{layer}_w_scale", ones, (4 * H,), jnp.float32)
+                for layer in range(self.num_layers)
+            ]
+            if self.fusion == "attention":
+                self.att_wf_scale = self.param(
+                    "att_wf_scale", ones, (A,), jnp.float32
+                )
+                self.att_wh_scale = self.param(
+                    "att_wh_scale", ones, (A,), jnp.float32
+                )
 
     # ------------------------------------------------------------- encoding
     def _encode(
@@ -264,13 +316,21 @@ class CaptionModel(nn.Module):
         att_vals = jnp.concatenate(vals, axis=1)
         att_mask = jnp.concatenate(masks, axis=1)
         if self.fusion == "attention":
-            att_proj = (
-                jnp.matmul(
-                    att_vals, self.att_wf.astype(cdt),
-                    preferred_element_type=jnp.float32,
-                )
-                + self.att_b.astype(jnp.float32)
-            ).astype(cdt)
+            if self.weight_quant:
+                # int8 att_wf with per-output-unit scales applied after
+                # the pinned f32 accumulation (ops/quant.py).
+                att_proj = (
+                    quant_matmul(att_vals, self.att_wf, self.att_wf_scale)
+                    + self.att_b.astype(jnp.float32)
+                ).astype(cdt)
+            else:
+                att_proj = (
+                    jnp.matmul(
+                        att_vals, self.att_wf.astype(cdt),
+                        preferred_element_type=jnp.float32,
+                    )
+                    + self.att_b.astype(jnp.float32)
+                ).astype(cdt)
         else:
             att_proj = jnp.zeros(att_vals.shape[:2] + (0,), cdt)
         if self.use_category:
@@ -300,10 +360,15 @@ class CaptionModel(nn.Module):
         cdt = jnp.dtype(self.compute_dtype)
         # f32 accumulation pinned (CST-DTY-003): under a bf16 compute
         # dtype the query GEMM must not accumulate in bf16.
-        q = jnp.matmul(
-            h_top.astype(cdt), self.att_wh.astype(cdt),
-            preferred_element_type=jnp.float32,
-        ).astype(cdt)  # (B, A)
+        if self.weight_quant:
+            q = quant_matmul(
+                h_top.astype(cdt), self.att_wh, self.att_wh_scale
+            ).astype(cdt)  # (B, A)
+        else:
+            q = jnp.matmul(
+                h_top.astype(cdt), self.att_wh.astype(cdt),
+                preferred_element_type=jnp.float32,
+            ).astype(cdt)  # (B, A)
         mesh = self.frame_mesh
         if (
             self.shard_frames
@@ -358,7 +423,14 @@ class CaptionModel(nn.Module):
         projection is applied by the caller (batched over time in forward,
         per-step in decode)."""
         cdt = jnp.dtype(self.compute_dtype)
-        emb = self.word_embed.astype(cdt)[tokens]
+        if self.weight_quant:
+            # Gather int8 rows first (1 byte/elem of HBM traffic), then
+            # reconstruct only the gathered rows (ops/quant.py).
+            emb = dequant_rows(
+                self.word_embed, self.word_embed_scale, tokens, cdt
+            )
+        else:
+            emb = self.word_embed.astype(cdt)[tokens]
         ctx = self._context(cache, state.h[-1])
         x = jnp.concatenate([emb, ctx.astype(cdt), cache.cat_emb], axis=-1)
         hs, cs = [], []
@@ -369,6 +441,7 @@ class CaptionModel(nn.Module):
                 state.h[layer],
                 state.c[layer],
                 compute_dtype=cdt,
+                w_scale=self.lstm_scales[layer] if self.weight_quant else None,
             )
             hs.append(h_new)
             cs.append(c_new)
@@ -395,6 +468,15 @@ class CaptionModel(nn.Module):
         cdt = jnp.dtype(self.compute_dtype)
         # The vocab GEMM accumulates f32 regardless of the compute
         # dtype (CST-DTY-003) — decode scores exit f32 by contract.
+        if self.weight_quant:
+            # int8 vocab tile: 0.25x the HBM bytes of the f32 projection
+            # per step; the per-logit scale multiplies the f32 accumulator
+            # so scores still exit f32 (and shard-aligned under TP — the
+            # (V,) scale carries the same vocab sharding as logit_w's
+            # columns).
+            return quant_matmul(
+                h.astype(cdt), self.logit_w, self.logit_w_scale
+            ) + self.logit_b.astype(jnp.float32)
         return jnp.matmul(
             h.astype(cdt), self.logit_w.astype(cdt),
             preferred_element_type=jnp.float32,
@@ -1030,14 +1112,35 @@ from cst_captioning_tpu.decoding.core import register_backend  # noqa: E402
 register_backend("scan_greedy", _scan_greedy_runner, kind="greedy")
 
 
-def model_from_config(cfg, mesh=None) -> CaptionModel:
+SERVING_DTYPES = ("f32", "bf16", "int8w")
+
+
+def model_from_config(cfg, mesh=None, serving_dtype=None) -> CaptionModel:
     """Build a CaptionModel from a ``Config`` (see ``config.py``).
 
     ``mesh`` enables frame sharding when ``model.shard_frames`` is set:
     the frame axis shards over the mesh's "model" axis, composing with the
     "data" batch axis when present.
+
+    ``serving_dtype`` is the low-precision SERVING override
+    (``serving.dtype``): passed only by the inference engine, never by the
+    trainer, so ``f32``/``None`` leaves the model byte-identical to
+    today's build.  ``bf16`` forces ``compute_dtype=bfloat16``; ``int8w``
+    additionally sets ``weight_quant`` (int8 codes + per-channel scales,
+    ops/quant.py) and declines every fused Pallas kernel — they stream
+    raw float weight tiles that no longer exist.
     """
     m, d = cfg.model, cfg.data
+    if serving_dtype is not None and serving_dtype not in SERVING_DTYPES:
+        raise ValueError(
+            f"unknown serving.dtype {serving_dtype!r}; expected one of "
+            f"{SERVING_DTYPES}"
+        )
+    compute_dtype = m.compute_dtype
+    weight_quant = False
+    if serving_dtype in ("bf16", "int8w"):
+        compute_dtype = "bfloat16"
+        weight_quant = serving_dtype == "int8w"
     if m.feature_fusion not in ("meanpool", "attention"):
         raise ValueError(
             f"unknown feature_fusion {m.feature_fusion!r}; "
@@ -1059,6 +1162,21 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         "data" if mesh is not None and mesh.shape.get("data", 1) > 1 else None
     )
     use_pallas_attention = getattr(m, "use_pallas_attention", False)
+    use_pallas_lstm = m.use_pallas_lstm
+    if weight_quant and (use_pallas_attention or use_pallas_lstm):
+        for flag, on in (
+            ("use_pallas_attention", use_pallas_attention),
+            ("use_pallas_lstm", use_pallas_lstm),
+        ):
+            if on:
+                warn_fused_decline(
+                    flag,
+                    "serving.dtype=int8w — fused kernels read raw float "
+                    "weight tiles, which weight-only quantization "
+                    "replaces",
+                )
+        use_pallas_attention = False
+        use_pallas_lstm = False
 
     # The fused sampler and beam kernels are gated by the CAPABILITY
     # TABLE (decoding/core.py::DECODE_KERNEL_CAPS, machine-checked by
@@ -1079,6 +1197,17 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
 
     def _decode_kernel_gate(flag_name: str) -> bool:
         if not getattr(m, flag_name, False):
+            return False
+        if weight_quant:
+            # The fused kernels stream raw float weight tiles from HBM;
+            # under int8w those tiles are quantized codes + separate
+            # scales, which no kernel reads.  The scan path's quant
+            # branches are the int8w fast path.
+            warn_fused_decline(
+                flag_name,
+                "serving.dtype=int8w — fused kernels read raw float "
+                "weight tiles, which weight-only quantization replaces",
+            )
             return False
         if m.num_layers != 1:
             # The in-model gate would decline anyway; say so up front.
@@ -1190,8 +1319,9 @@ def model_from_config(cfg, mesh=None) -> CaptionModel:
         use_category=m.use_category,
         num_categories=d.num_categories,
         category_embed_size=m.category_embed_size,
-        compute_dtype=m.compute_dtype,
+        compute_dtype=compute_dtype,
         param_dtype=m.param_dtype,
-        use_pallas=m.use_pallas_lstm,
+        weight_quant=weight_quant,
+        use_pallas=use_pallas_lstm,
         remat=cfg.train.remat,
     )

@@ -1,0 +1,215 @@
+"""Weight-only int8 quantization for the low-precision serving path.
+
+``serving.dtype = int8w`` (config.py) stores the large weight matrices —
+the vocab projection ``logit_w``, the embedding rows ``word_embed``, the
+LSTM kernels, and the attention MLP projections — as int8 with one
+float32 scale per output channel, computed ONCE at engine boot (or AOT
+artifact build) from the float checkpoint.  Activations run bf16,
+accumulation stays float32 via the same ``preferred_element_type`` pins
+the bf16 path carries (CST-DTY-003), and every decode DECISION — beam
+top-K keys, greedy argmax, the sampler's Gumbel race — consumes float32
+logits exactly as before: the scale is applied AFTER the f32
+accumulation, so the quantized matmul exits f32 like ``_logits`` always
+has.
+
+Symmetric per-channel scheme: ``scale_c = max|w_c| / 127`` (1.0 for an
+all-zero channel), ``q = clip(round(w / scale), -127, 127)``.  The
+round-trip error is bounded by ``scale/2`` per element — pinned by
+tests/test_quant.py.  int8 magnitudes (<= 127) are exactly representable
+in bfloat16 (8 mantissa bits cover integers to 256), so the
+``q.astype(bf16)`` feed into the MXU is lossless; the only rounding in
+the scheme is the one quantization round.
+
+The parity story for everything here is the ``relaxed-serving``
+CAST_REGISTRY tier (analysis/jit_registry.py::PARITY_TIERS): rounding
+CAN move tokens, so the contract is the machine-checked pair
+(caption-match rate vs f32 >= RELAXED_SERVING_MATCH_FLOOR, per-caption
+score gap <= RELAXED_SERVING_SCORE_RTOL) on a fixed eval set —
+docs/PARITY.md r17.
+
+Sharding: a scale vector rides WITH its weight leaf (``<name>_scale``)
+and shards on the same mesh axis as the channel dimension it scales
+(parallel/partition.py rules), so int8 composes with
+``serving.model_shards`` — each shard holds its own vocab-tile scales
+and the post-accumulation multiply is shard-aligned with no gather.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Leaf-name pattern -> quantized channel axis.  The channel axis is the
+# one whose per-entry max-abs sets the scale: rows of the embedding
+# (axis 0 — one scale per vocab row travels with its row shard), output
+# columns everywhere else (axis 1 — one scale per logit/gate/attention
+# unit).  Biases, ``att_v``, ``att_b``, and the small feature
+# projections stay float32: they are epilogue adds, not GEMM operands.
+_QUANT_AXIS_RULES: Tuple[Tuple[str, int], ...] = (
+    (r"word_embed$", 0),
+    (r"logit_w$", 1),
+    (r"lstm\d+_w$", 1),
+    (r"att_w[fh]$", 1),
+)
+
+SCALE_SUFFIX = "_scale"
+
+
+def quant_axis(name: str) -> Optional[int]:
+    """Channel axis for a quantizable param leaf name, else None."""
+    for pat, axis in _QUANT_AXIS_RULES:
+        if re.search(pat, name):
+            return axis
+    return None
+
+
+def quantize_per_channel(
+    w, axis: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-channel int8 quantization of ``w`` along ``axis``.
+
+    Returns ``(q int8, scale float32)`` with ``scale.shape ==
+    (w.shape[axis],)``.  An all-zero channel gets scale 1.0 so
+    dequantization is always well-defined."""
+    w = jnp.asarray(w, jnp.float32)
+    reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
+    amax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(
+        jnp.round(w / _bshape(scale, w.ndim, axis)), -127.0, 127.0
+    ).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def _bshape(scale: jnp.ndarray, ndim: int, axis: int) -> jnp.ndarray:
+    """Reshape a (C,) scale for broadcasting along ``axis`` of an
+    ndim-rank tensor."""
+    shape = [1] * ndim
+    shape[axis] = -1
+    return scale.reshape(shape)
+
+
+def dequantize(q, scale, axis: int) -> jnp.ndarray:
+    """Float32 reconstruction (test/reference path — the serving matmuls
+    never materialize this; they scale after the f32 accumulation)."""
+    return q.astype(jnp.float32) * _bshape(
+        jnp.asarray(scale, jnp.float32), jnp.ndim(q), axis
+    )
+
+
+def quant_matmul(x, q, scale) -> jnp.ndarray:
+    """``x @ dequant(q)`` without materializing the dequantized weight:
+    int8 columns feed the GEMM at the activation dtype (lossless — int8
+    magnitudes are exact in bf16), accumulation is pinned float32
+    (CST-DTY-003), and the per-output-channel scale is applied AFTER the
+    accumulation, in float32 — so decode logits exit f32 exactly like
+    the unquantized ``_logits`` contract.  ``q``: (K, N) int8 with
+    per-column ``scale``: (N,) float32; ``x``: (..., K)."""
+    acc = jnp.matmul(
+        x, q.astype(x.dtype), preferred_element_type=jnp.float32
+    )
+    return acc * scale.astype(jnp.float32)
+
+
+def dequant_rows(q, scale, ids, compute_dtype) -> jnp.ndarray:
+    """Embedding lookup from per-row-quantized storage: gather the int8
+    rows FIRST (1 byte/element moved instead of 4), then reconstruct the
+    gathered rows in f32 and round once to the compute dtype — the same
+    single f32->cdt rounding the float path's ``astype(cdt)[ids]``
+    performs."""
+    rows = q[ids].astype(jnp.float32) * scale[ids][..., None].astype(
+        jnp.float32
+    )
+    return rows.astype(compute_dtype)
+
+
+# ------------------------------------------------------------- tree ops
+
+def _param_dict(params) -> Dict[str, Any]:
+    return params["params"] if "params" in params else params
+
+
+def quantize_params(params):
+    """Quantize every quantizable leaf of a float param tree IN the tree:
+    each matched leaf becomes int8 and gains (or overwrites) its
+    ``<name>_scale`` sibling.  Runs once, host-side, at engine boot or
+    artifact build — never inside a traced function."""
+    p = dict(_param_dict(params))
+    for name in sorted(p):
+        axis = quant_axis(name)
+        if axis is None:
+            continue
+        q, scale = quantize_per_channel(p[name], axis)
+        p[name] = q
+        p[name + SCALE_SUFFIX] = scale
+    if "params" in params:
+        out = dict(params)
+        out["params"] = p
+        return out
+    return p
+
+
+def quantize_template(template):
+    """Shape/dtype twin of :func:`quantize_params` over an aval/ndarray
+    template (no values): quantizable leaves become int8 zeros, scale
+    siblings f32 ones — the restore template for a checkpoint that was
+    SAVED quantized (an int8w AOT artifact's params item)."""
+    p = dict(_param_dict(template))
+    for name in sorted(p):
+        axis = quant_axis(name)
+        if axis is None:
+            continue
+        shape = tuple(p[name].shape)
+        p[name] = np.zeros(shape, np.int8)
+        p[name + SCALE_SUFFIX] = np.ones((shape[axis],), np.float32)
+    if "params" in template:
+        out = dict(template)
+        out["params"] = p
+        return out
+    return p
+
+
+def is_quantized(params) -> bool:
+    """True when the tree already carries int8 weight leaves (an
+    artifact restore or a clone of a quantized engine) — boot-time
+    quantization must be idempotent, never double-applied."""
+    p = _param_dict(params)
+    for name, leaf in p.items():
+        if quant_axis(name) is not None:
+            return jnp.dtype(getattr(leaf, "dtype", None)) == jnp.int8
+    return False
+
+
+def scale_hashes(params) -> Dict[str, str]:
+    """sha256 (16 hex chars) of every scale vector's f32 bytes — the
+    artifact-manifest integrity record: a loader that reconstructs
+    different scales from the same artifact refuses field-by-field
+    (serving/artifact.py)."""
+    p = _param_dict(params)
+    out: Dict[str, str] = {}
+    for name in sorted(p):
+        if not name.endswith(SCALE_SUFFIX):
+            continue
+        host = np.asarray(
+            jax.device_get(p[name]), np.float32
+        )
+        out[name] = hashlib.sha256(host.tobytes()).hexdigest()[:16]
+    return out
+
+
+# -------------------------------------------------- byte accounting
+
+def quantized_leaf_bytes(shape, axis: int) -> Tuple[int, int]:
+    """Closed-form (int8 weight bytes, f32 scale bytes) for one
+    quantized leaf — the bench's exact-arithmetic check against measured
+    ``nbytes`` (docs/PERF.md r15): int8 weight bytes are exactly 0.25x
+    the f32 leaf, plus a shape[axis]*4-byte scale vector."""
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n, int(shape[axis]) * 4

@@ -59,6 +59,7 @@ def lstm_step(
     c: jax.Array,
     *,
     compute_dtype=None,
+    w_scale=None,
 ) -> Tuple[jax.Array, jax.Array]:
     """One LSTM step: ``(h', c') = cell(x, (h, c))``.
 
@@ -67,6 +68,13 @@ def lstm_step(
     matmul epilogue.  The cell state ``c`` is kept in float32 even when
     activations run in bfloat16 — the additive recurrence accumulates
     rounding error otherwise.
+
+    ``w_scale`` is the int8 weight-only serving path (``serving.dtype =
+    int8w``, ops/quant.py): ``weights.w`` holds int8 codes and ``w_scale``
+    the (4*hidden,) per-gate-column float32 scales, applied AFTER the f32
+    accumulation so the gate pre-activations are identical in structure to
+    the float path.  int8 magnitudes are exact in bf16, so the
+    ``astype(compute_dtype)`` on the codes is lossless.
     """
     hidden = h.shape[-1]
     if compute_dtype is not None:
@@ -81,6 +89,8 @@ def lstm_step(
         jnp.concatenate([x, h], axis=-1), w,
         preferred_element_type=jnp.float32,
     )
+    if w_scale is not None:
+        gates = gates * w_scale.astype(jnp.float32)
     gates = gates + weights.b.astype(jnp.float32)
     i, f, g, o = jnp.split(gates, 4, axis=-1)
     c_new = jax.nn.sigmoid(f) * c.astype(jnp.float32) + jax.nn.sigmoid(i) * jnp.tanh(g)

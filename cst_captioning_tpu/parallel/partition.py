@@ -52,6 +52,17 @@ PARTITION_RULES = (
     (r"proj_[A-Za-z0-9]+_[wb]$", ()),        # feature projections
     (r"att_(b|v|wf|wh)$", ()),               # Bahdanau attention MLP
     (r"cat_embed$", ()),                     # category embedding
+    # int8 weight-only serving (ops/quant.py): each per-channel scale
+    # vector shards on the SAME mesh axis as the channel dimension of
+    # the weight it dequantizes, so the post-accumulation multiply is
+    # shard-aligned — no gather.  (V,)-sized scales follow the vocab
+    # axis; per-gate/per-attention-unit scales are small and replicate
+    # with their kernels.  The `$`-anchored weight rules above cannot
+    # match `*_scale` names, so exactly-one-match (CST-SHD-001) holds.
+    (r"word_embed_scale$", ("model",)),      # (V,): rows of word_embed
+    (r"logit_w_scale$", ("model",)),         # (V,): columns of logit_w
+    (r"lstm\d+_w_scale$", ()),               # (4H,): replicated kernels
+    (r"att_w[fh]_scale$", ()),               # (A,): replicated att MLP
 )
 
 # Canonical param-leaf names across every model configuration
@@ -76,6 +87,14 @@ KNOWN_PARAM_LEAVES = (
     "att_wf",
     "att_wh",
     "cat_embed",
+    # int8w serving scale leaves (weight_quant trees only; see the scale
+    # rules above and tests/test_partition.py's weight_quant variant).
+    "word_embed_scale",
+    "logit_w_scale",
+    "lstm0_w_scale",
+    "lstm1_w_scale",
+    "att_wf_scale",
+    "att_wh_scale",
 )
 
 

@@ -195,6 +195,8 @@ def build_artifact(engine, out_root: str) -> Dict[str, Any]:
     encode_keys = {
         k: _hlo_key(low) for k, low in lowered if k.startswith("encode:")
     }
+    from cst_captioning_tpu.ops import quant
+
     fp = dict(engine.fingerprint())
     fp.pop("artifact_version", None)  # the artifact NAMES the version
     core = {
@@ -203,6 +205,14 @@ def build_artifact(engine, out_root: str) -> Dict[str, Any]:
         "env": environment_block(),
         "variants": variant_keys,
         "encode_variants": encode_keys,
+        # Low-precision provenance (ISSUE 16): the engine's serving
+        # dtype and — for int8w builds — a content hash per dequant
+        # scale vector.  In `core`, so a dtype or scale change names a
+        # NEW artifact version, and the loader refuses divergence
+        # field-by-field like every other manifest field.  f32/bf16
+        # builds carry no scale leaves: scale_hashes is {}.
+        "serving_dtype": fp.get("serving_dtype", "f32"),
+        "scale_hashes": quant.scale_hashes(engine.params),
     }
     version = "v" + hashlib.sha256(
         json.dumps(core, sort_keys=True).encode()
@@ -368,6 +378,29 @@ def load_engine(path: str, engine_cls=None, replica_id=None):
             ))
         if cfg.name != fp.get("preset"):
             mm.append(("fingerprint.preset", fp.get("preset"), cfg.name))
+        # Low-precision refusal (ISSUE 16): a manifest whose recorded
+        # serving_dtype diverges from the engine the config builds, or
+        # whose dequant scales no longer hash to what was published, is
+        # a named mismatch — never a silent parity change.
+        built_dtype = man.get("serving_dtype", "f32")
+        if engine.serving_dtype != built_dtype:
+            mm.append((
+                "serving_dtype", built_dtype, engine.serving_dtype,
+            ))
+        from cst_captioning_tpu.ops import quant
+
+        live_hashes = quant.scale_hashes(engine.params)
+        built_hashes = man.get("scale_hashes", {})
+        drifted = sorted(
+            k for k in set(live_hashes) | set(built_hashes)
+            if live_hashes.get(k) != built_hashes.get(k)
+        )
+        if drifted:
+            mm.append((
+                "scale_hashes",
+                {k: built_hashes.get(k) for k in drifted},
+                {k: live_hashes.get(k) for k in drifted},
+            ))
         decoder = engine.slot_decoder()
         # Drift refusal: the variant set is RE-DERIVED from the live
         # ladder code and must equal the manifest's — a ladder change

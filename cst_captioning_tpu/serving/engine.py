@@ -160,7 +160,20 @@ class InferenceEngine:
             # mesh that carries data > 1 activation-shards the slot
             # rows over it (serving/slots.py::_init_state).
             self.tp_mesh = mesh
-        self.model: CaptionModel = model_from_config(cfg, mesh=mesh)
+        # Low-precision serving path (serving.dtype, ops/quant.py):
+        # "f32" leaves the whole build byte-identical to the pre-knob
+        # engine; "bf16"/"int8w" reshape the model via the serving_dtype
+        # override of model_from_config.  Validated HERE so a typo'd
+        # config fails at boot with the knob's name.
+        self.serving_dtype = str(getattr(sv, "dtype", "f32") or "f32")
+        if self.serving_dtype not in ("f32", "bf16", "int8w"):
+            raise ValueError(
+                f"unknown serving.dtype {self.serving_dtype!r}; expected "
+                "'f32', 'bf16', or 'int8w'"
+            )
+        self.model: CaptionModel = model_from_config(
+            cfg, mesh=mesh, serving_dtype=self.serving_dtype
+        )
         if params is None:
             if checkpoint:
                 params = self._restore(checkpoint)
@@ -172,6 +185,15 @@ class InferenceEngine:
                     "InferenceEngine needs `params`, a `checkpoint` path, "
                     "or random_init=True"
                 )
+        if self.serving_dtype == "int8w":
+            from cst_captioning_tpu.ops import quant
+
+            # Quantize ONCE at boot (per-channel scales from the float
+            # weights) unless the tree already carries int8 codes — an
+            # AOT artifact restore or a clone of a quantized engine, for
+            # which re-quantizing would be lossy double rounding.
+            if not quant.is_quantized(params):
+                params = quant.quantize_params(params)
         if self.tp_mesh is not None:
             from cst_captioning_tpu.parallel import shard_params
 
@@ -205,6 +227,12 @@ class InferenceEngine:
             f"{self.decode_mode}|K{cfg.eval.beam_size}|"
             f"L{cfg.eval.max_decode_len}|ln{cfg.eval.length_normalize}"
         )
+        if self.serving_dtype != "f32":
+            # Low-precision decode can move tokens (relaxed-serving
+            # tier), so the dtype is cache-key-relevant.  Appended only
+            # off-f32: the f32 tag — like every other f32 byte — is
+            # identical to the pre-knob engine.
+            self.params_tag += f"|dt{self.serving_dtype}"
         self._feats_fns: Dict[int, Any] = {}
         self._encode_fns: Dict[int, Any] = {}
         self._state_fns: Dict[int, Any] = {}
@@ -283,18 +311,45 @@ class InferenceEngine:
 
     def _restore(self, checkpoint: str):
         """Orbax params-only restore against an eval_shape template —
-        the exact ``cli/test.py`` loading path."""
+        the exact ``cli/test.py`` loading path.
+
+        Under ``serving.dtype=int8w`` the checkpoint may hold EITHER a
+        quantized tree (an AOT artifact's params item: int8 codes + f32
+        scales) or an ordinary float training checkpoint.  Try the
+        quantized template first — dtype-exact restore, no silent
+        casting of int8 codes through a float template — and fall back
+        to the float twin (the ctor quantizes the restored floats at
+        boot)."""
         from cst_captioning_tpu.training.checkpoint import restore_params
 
         feats, masks, ids, cat = self._template_inputs()
+        # The float twin: a weight_quant model's own init tree carries
+        # scale leaves a training checkpoint doesn't have, so the float
+        # template always comes from the unquantized clone.
+        float_model = (
+            self.model.clone(weight_quant=False)
+            if getattr(self.model, "weight_quant", False)
+            else self.model
+        )
         template = jax.eval_shape(
-            lambda: self.model.init(
+            lambda: float_model.init(
                 jax.random.PRNGKey(0), feats, masks, ids, category=cat
             )
         )
         template = jax.tree.map(
             lambda s: np.zeros(s.shape, s.dtype), template
         )
+        if self.serving_dtype == "int8w":
+            from cst_captioning_tpu.ops import quant
+
+            try:
+                return restore_params(
+                    checkpoint, quant.quantize_template(template)
+                )
+            except Exception:
+                # Not a quantized save — restore the float tree below;
+                # the ctor quantizes it once at boot.
+                pass
         return restore_params(checkpoint, template)
 
     def bucket(self, n: int) -> int:
@@ -898,10 +953,29 @@ class InferenceEngine:
             "mesh_shape": self._mesh_shape_str(),
             "preset": self.cfg.name,
             "version": __version__,
+            # Low-precision serving path (f32 | bf16 | int8w): parity-
+            # relevant, so artifacts refuse a mismatch field-by-field
+            # (serving/artifact.py) and /healthz exposes it per replica.
+            "serving_dtype": self.serving_dtype,
             # "warm" = self-compiled ladder; otherwise the AOT artifact
             # version this engine (or its clone source) booted from.
             "artifact_version": self.artifact_version,
         }
+
+    def param_bytes_per_shard(self) -> int:
+        """Resident weight bytes on ONE shard of this engine — measured
+        off the live leaves (a model-sharded leaf counts its first
+        addressable shard), so the int8w 0.25x vocab-tile claim is
+        checked against reality, not arithmetic (the lowprec_* bench
+        rows pair this with the ops/quant.py closed form)."""
+        total = 0
+        for leaf in jax.tree.leaves(self.params):
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards and self.tp_mesh is not None:
+                total += int(shards[0].data.nbytes)
+            else:
+                total += int(np.asarray(leaf).nbytes)
+        return total
 
     def describe(self) -> Dict[str, Any]:
         return {
@@ -924,6 +998,11 @@ class InferenceEngine:
             "vocab_size": len(self.vocab),
             "backend": jax.default_backend(),
             "mesh_shape": self._mesh_shape_str(),
+            # Low-precision serving: the active dtype and the measured
+            # resident weight bytes on one shard (int8w ~0.25x the f32
+            # vocab tiles) — /healthz and /stats carry both.
+            "serving_dtype": self.serving_dtype,
+            "param_bytes_per_shard": self.param_bytes_per_shard(),
             # Deploy fingerprint: params_tag/mesh/preset/version —
             # /healthz carries it so dumps and bench records correlate.
             "build": self.fingerprint(),

@@ -1181,6 +1181,15 @@ class SlotDecoder:
             "mode": "greedy" if self.greedy else "beam",
             "admit_cap": self.admit_cap,
             "dedup_cache": self.dedup,
+            # Low-precision serving (serving.dtype): the tick lattice
+            # runs at the model's compute dtype, so every byte gauge
+            # below is already honest under bf16/int8w — state_bytes
+            # measures the live leaves and expected_state_bytes uses
+            # the same cdt itemsize.  Quantized WEIGHT bytes live on
+            # the engine (param_bytes_per_shard), not in decode state.
+            "serving_dtype": getattr(
+                self.engine, "serving_dtype", "f32"
+            ),
             "state_bytes": self.state_bytes(),
             "live_state_bytes": self.live_state_bytes(),
             "bytes_per_request": self.per_slot_bytes(),

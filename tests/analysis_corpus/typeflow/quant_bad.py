@@ -1,0 +1,37 @@
+# corpus-rules: dtypeflow
+"""Seeded ISSUE-16 low-precision serving violations: an unregistered
+quant cast reachable from a jit root (001 — weight-only int8 codes cast
+to the activation dtype with no CAST_REGISTRY entry claiming a parity
+tier for the rounding) and a decision-path vocab matmul on a registered
+``relaxed-serving`` path missing its f32 accumulation pin (003 — the
+corpus test injects the ``low_precision=True`` entry for
+``registered_quant_path``).  The negative case proves the rules stay
+quiet on the exact idiom ops/quant.py ships: registered cast, f32
+accumulation pinned, per-channel scale applied after the accumulation."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def unregistered_quant_cast(q, scale):
+    # int8 codes dequantized inline with no CAST_REGISTRY entry saying
+    # which PARITY tier survives the quantization rounding
+    return q.astype(jnp.bfloat16) * scale  # expect: CST-DTY-001
+
+
+@jax.jit
+def registered_quant_path(h, q, scale):
+    # the cast sites are registered (relaxed-serving entry injected by
+    # the test) ...
+    hc = h.astype(jnp.bfloat16)
+    # ... but the DECISION matmul — vocab logits feeding beam top-K —
+    # must still pin f32 accumulation: applying the scale after a bf16
+    # accumulator does not un-round it
+    bad = jnp.matmul(hc, q.astype(jnp.bfloat16)) * scale  # expect: CST-DTY-003
+    # negative: the ops/quant.py idiom — pinned f32 accumulation, scale
+    # applied after, so decisions consume f32
+    good = jnp.matmul(
+        hc, q.astype(jnp.bfloat16), preferred_element_type=jnp.float32
+    ) * scale
+    return bad + good

@@ -445,10 +445,18 @@ class TestCorpus:
         JIT_SITE_REGISTRY[key] = JitSite(
             "corpus-injected update step", update_step=True
         )
-        # the CST-DTY-003 seeds live on a registered low-precision path
+        # the CST-DTY-003 seeds live on registered low-precision paths
+        # (legal tiers — an illegal tier would now fire the ISSUE-16
+        # tier-vocabulary check against the registry itself)
         cast_key = "typeflow/dty_bad.py::registered_low_precision"
         CAST_REGISTRY[cast_key] = CastSite(
-            "corpus", "corpus-injected low-precision path",
+            "relaxed-rtol", "corpus-injected low-precision path",
+            low_precision=True,
+        )
+        quant_key = "typeflow/quant_bad.py::registered_quant_path"
+        CAST_REGISTRY[quant_key] = CastSite(
+            "relaxed-serving",
+            "corpus-injected quantized decision path",
             low_precision=True,
         )
         # configflow's doc-coverage rule (CST-CFG-003) runs against the
@@ -466,6 +474,7 @@ class TestCorpus:
         finally:
             del JIT_SITE_REGISTRY[key]
             del CAST_REGISTRY[cast_key]
+            del CAST_REGISTRY[quant_key]
         return findings
 
     def test_every_seeded_violation_fires_exactly_its_rule(
@@ -682,6 +691,41 @@ class TestRegistryFaults:
             f.rule == "CST-DON-001" and f.file == "training/steps.py"
             for f in findings
         )
+
+    def test_every_live_cast_tier_is_legal(self):
+        """The ISSUE-16 tier vocabulary: every CAST_REGISTRY entry names
+        a PARITY_TIERS member, and the relaxed-serving bounds are sane
+        pinned constants (a fraction floor, a small positive rtol)."""
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        for key, entry in jr.CAST_REGISTRY.items():
+            assert entry.tier in jr.PARITY_TIERS, (key, entry.tier)
+        assert "relaxed-serving" in jr.PARITY_TIERS
+        assert 0.0 < jr.RELAXED_SERVING_MATCH_FLOOR <= 1.0
+        assert 0.0 < jr.RELAXED_SERVING_SCORE_RTOL < 1.0
+
+    def test_illegal_cast_tier_fires_dty001(self, monkeypatch):
+        """An entry claiming a tier outside PARITY_TIERS — a typo'd or
+        invented guarantee — must surface against the registry itself."""
+        from cst_captioning_tpu.analysis import jit_registry as jr
+
+        key = "ops/quant.py::quant_matmul"
+        assert key in jr.CAST_REGISTRY
+        monkeypatch.setitem(
+            jr.CAST_REGISTRY, key,
+            jr.CastSite(
+                "close-enough", "typo'd tier", low_precision=True
+            ),
+        )
+        mods, ctx = self._ctx_mods()
+        findings = CHECKERS["dtypeflow"](mods, ctx)
+        assert any(
+            f.rule == "CST-DTY-001"
+            and f.file == "analysis/jit_registry.py"
+            and key in f.message
+            and "illegal parity tier" in f.message
+            for f in findings
+        ), [f.render() for f in findings]
 
     def test_duplicate_metric_family_fires_met003(self, monkeypatch):
         import cst_captioning_tpu.serving.metrics as sm

@@ -280,6 +280,64 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="mesh"):
             validate_record(rec)
 
+    def test_lowprec_row_passes(self):
+        """A well-formed f32/bf16/int8w serving row (ISSUE 16):
+        numeric measurements, unit-interval match rates, provenance
+        strings exempted by name."""
+        rec = good_bench()
+        rec["extra"].update({
+            "lowprec_mesh_shape": "1x2",
+            "lowprec_xla_flags": "--xla_force…=2",
+            "lowprec_jax_platforms": "cpu",
+            "lowprec_host_cores": 1.0,
+            "lowprec_match_floor": 0.75,
+            "lowprec_score_rtol": 0.02,
+            "lowprec_f32_captions_per_sec": 2630.2,
+            "lowprec_int8w_captions_per_sec": 2521.5,
+            "lowprec_int8w_p99_batch_ms": 4.57,
+            "lowprec_int8w_match_rate": 1.0,
+            "lowprec_bf16_match_rate": 0.875,
+            "lowprec_int8w_score_gap_max": 0.000183,
+            "lowprec_vocab_tile_f32_bytes": 65536,
+            "lowprec_vocab_tile_int8w_bytes": 16384,
+            "lowprec_vocab_tile_ratio": 0.25,
+            "lowprec_int8w_param_bytes_per_shard": 60544,
+            "lowprec_virtual_cpu": 1,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "fast", [1.0]])
+    def test_non_numeric_lowprec_field_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["lowprec_int8w_captions_per_sec"] = bad
+        with pytest.raises(
+            ValueError, match="lowprec_int8w_captions_per_sec"
+        ):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [-0.1, 1.5, 100.0])
+    def test_lowprec_match_rate_outside_unit_interval_fails(self, bad):
+        """Match rates are caption-match FRACTIONS: the parity gate
+        compares them to the pinned floor, so a percentage or a
+        miscount must fail the emit."""
+        rec = good_bench()
+        rec["extra"]["lowprec_bf16_match_rate"] = bad
+        with pytest.raises(ValueError, match="match_rate"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "all"])
+    def test_bool_lowprec_match_rate_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["lowprec_int8w_tp2_match_rate"] = bad
+        with pytest.raises(ValueError, match="lowprec_int8w_tp2"):
+            validate_record(rec)
+
+    def test_lowprec_mesh_shape_still_topology_checked(self):
+        rec = good_bench()
+        rec["extra"]["lowprec_mesh_shape"] = "one-by-two"
+        with pytest.raises(ValueError, match="mesh"):
+            validate_record(rec)
+
     def test_mesh_shape_string_passes(self):
         """*_mesh_shape fields carry the topology a row ran on (ISSUE
         9): a "2x4"-style string in declared axis order."""

@@ -442,6 +442,9 @@ class TestServedRequestTimeline:
         assert build["params_tag"] == srv.engine.params_tag
         assert build["mesh_shape"] == "1x1"
         assert build["preset"] == "synthetic_smoke"
+        # low-precision provenance (ISSUE 16): the serving dtype is
+        # part of the build identity on every HTTP surface
+        assert build["serving_dtype"] == "f32"
         assert re.fullmatch(r"\d+\.\d+\.\d+", build["version"])
         # /healthz carries the same block
         _, _, hz = _get(srv.url + "/healthz")

@@ -61,9 +61,12 @@ class TestPartitionRules:
         it, and every entry must exist in SOME real tree (no rot in
         either direction)."""
         seen = set()
-        for fusion, cat, layers in (
-            ("meanpool", False, 1),
-            ("attention", True, 2),
+        for fusion, cat, layers, serving_dtype in (
+            ("meanpool", False, 1, None),
+            ("attention", True, 2, None),
+            # weight_quant tree (ISSUE 16): the int8w serving model adds
+            # the *_scale leaves — they must be KNOWN and rule-covered.
+            ("attention", True, 2, "int8w"),
         ):
             cfg = get_preset("synthetic_smoke")
             cfg.model.feature_fusion = fusion
@@ -72,7 +75,7 @@ class TestPartitionRules:
             cfg.model.vocab_size = 32
             cfg.data.feature_modalities = ["resnet", "c3d"]
             cfg.data.feature_dims = {"resnet": 16, "c3d": 16}
-            m = model_from_config(cfg)
+            m = model_from_config(cfg, serving_dtype=serving_dtype)
             feats = {
                 k: jnp.zeros((1, 4, 16)) for k in ("resnet", "c3d")
             }

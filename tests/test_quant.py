@@ -1,0 +1,420 @@
+"""Low-precision serving fast path (ISSUE 16, ops/quant.py + the
+``serving.dtype`` knob).
+
+Pins the tentpole contracts:
+
+* **Quantization math** — symmetric per-channel int8 weight-only
+  codes round-trip within half a scale step per element, all-zero
+  channels quantize exactly (scale 1.0 guard), and ``quant_matmul``
+  accumulates f32 with the scale applied AFTER the accumulation (the
+  CST-DTY-003 idiom the corpus seed mirrors).
+* **Scale sharding** — every ``*_scale`` leaf's partition spec follows
+  the channel axis of the weight it dequantizes (shard-aligned
+  post-accumulation multiply, no gather), straight from the live
+  rule table, keyed by ``quant_axis``.
+* **f32 byte-identity** — ``serving.dtype="f32"`` is byte-identical
+  to an engine that never heard of the knob: same params bytes, same
+  ``params_tag`` (cache keys keep hitting), no scale leaves.
+* **Relaxed-serving parity** — bf16/int8w engines hold the pinned
+  machine-checked bounds vs the f32 engine on the fixed eval set:
+  caption-match rate >= RELAXED_SERVING_MATCH_FLOOR and per-caption
+  beam-score gap <= RELAXED_SERVING_SCORE_RTOL
+  (analysis/jit_registry.py, docs/PARITY.md r17).
+* **Quantized AOT artifacts** — an int8w engine publishes its scales
+  (hashed into the artifact version), boots from the artifact with
+  ``compile_count == 0`` token-exact vs warm, and the loader refuses
+  a ``serving_dtype`` or scale-hash divergence by name.
+"""
+
+import json
+import os
+import shutil
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from cst_captioning_tpu.analysis.jit_registry import (
+    RELAXED_SERVING_MATCH_FLOOR,
+    RELAXED_SERVING_SCORE_RTOL,
+)
+from cst_captioning_tpu.config import get_preset
+from cst_captioning_tpu.data.vocab import Vocabulary
+from cst_captioning_tpu.decoding.beam import make_beam_search_fn
+from cst_captioning_tpu.ops import quant
+from cst_captioning_tpu.parallel import partition
+from cst_captioning_tpu.serving.artifact import (
+    MANIFEST_NAME,
+    ArtifactMismatchError,
+    build_artifact,
+)
+from cst_captioning_tpu.serving.engine import InferenceEngine
+
+
+# ------------------------------------------------------------- primitives
+
+class TestQuantPrimitives:
+    def test_round_trip_within_half_a_step(self):
+        rng = np.random.RandomState(0)
+        w = jnp.asarray(rng.randn(16, 24).astype(np.float32) * 3.0)
+        for axis in (0, 1):
+            q, scale = quant.quantize_per_channel(w, axis)
+            assert q.dtype == jnp.int8
+            assert scale.dtype == jnp.float32
+            assert scale.shape == (w.shape[axis],)
+            assert int(jnp.max(jnp.abs(q))) <= 127
+            dq = quant.dequantize(q, scale, axis)
+            shape = [1, 1]
+            shape[axis] = w.shape[axis]
+            step = scale.reshape(shape)
+            # symmetric rounding: |w - dq| <= scale/2 per element
+            assert bool(jnp.all(jnp.abs(w - dq) <= step / 2 + 1e-6))
+
+    def test_zero_channel_gets_unit_scale_and_exact_zero(self):
+        w = jnp.zeros((4, 6), jnp.float32).at[1].set(2.0)
+        q, scale = quant.quantize_per_channel(w, 0)
+        assert float(scale[0]) == 1.0          # guard, not 0/0
+        assert bool(jnp.all(q[0] == 0))
+        dq = quant.dequantize(q, scale, 0)
+        assert bool(jnp.all(dq[0] == 0.0))
+        # the nonzero channel saturates its own range exactly at max
+        assert int(jnp.max(jnp.abs(q[1]))) == 127
+
+    def test_quant_matmul_is_f32_scale_after_accumulation(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 16).astype(np.float32)).astype(
+            jnp.bfloat16
+        )
+        w = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+        q, scale = quant.quantize_per_channel(w, 1)
+        y = quant.quant_matmul(x, q, scale)
+        assert y.dtype == jnp.float32
+        # scale-after-accumulation: y == (x @ q) * scale with the codes
+        # accumulated in f32 — int8 magnitudes are exact in bf16, so
+        # the quantized matmul adds NO error beyond the code rounding
+        ref = (
+            jnp.matmul(
+                x.astype(jnp.float32), q.astype(jnp.float32)
+            ) * scale
+        )
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(ref), rtol=1e-5, atol=1e-5
+        )
+
+    def test_dequant_rows_matches_full_dequantize(self):
+        rng = np.random.RandomState(2)
+        emb = jnp.asarray(rng.randn(10, 8).astype(np.float32))
+        q, scale = quant.quantize_per_channel(emb, 0)
+        ids = jnp.asarray([3, 0, 7], jnp.int32)
+        rows = quant.dequant_rows(q, scale, ids, jnp.bfloat16)
+        assert rows.dtype == jnp.bfloat16
+        full = quant.dequantize(q, scale, 0).astype(jnp.bfloat16)
+        assert bool(jnp.all(rows == full[ids]))
+
+    def test_quantize_params_and_template_agree(self):
+        rng = np.random.RandomState(3)
+        tree = {"params": {
+            "word_embed": jnp.asarray(rng.randn(8, 4), jnp.float32),
+            "logit_w": jnp.asarray(rng.randn(4, 8), jnp.float32),
+            "lstm0_w": jnp.asarray(rng.randn(8, 16), jnp.float32),
+            "att_wf": jnp.asarray(rng.randn(4, 6), jnp.float32),
+            "att_b": jnp.asarray(rng.randn(6), jnp.float32),
+        }}
+        assert not quant.is_quantized(tree)
+        qt = quant.quantize_params(tree)
+        p = qt["params"]
+        assert quant.is_quantized(qt)
+        assert p["word_embed"].dtype == jnp.int8
+        assert p["word_embed_scale"].shape == (8,)
+        assert p["logit_w_scale"].shape == (8,)       # axis 1 channels
+        assert p["lstm0_w_scale"].shape == (16,)
+        assert p["att_wf_scale"].shape == (6,)
+        assert p["att_b"].dtype == jnp.float32        # biases untouched
+        # the zero-filled template names the SAME tree structure (what
+        # restore_params needs to load a quantized artifact checkpoint)
+        t = quant.quantize_template(
+            jax.tree.map(lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                         tree)
+        )
+        assert (jax.tree_util.tree_structure(t)
+                == jax.tree_util.tree_structure(qt))
+
+    def test_quantized_leaf_bytes_closed_form(self):
+        wbytes, sbytes = quant.quantized_leaf_bytes((64, 256), 1)
+        assert wbytes == 64 * 256          # 1 byte/element
+        assert sbytes == 256 * 4           # f32 scale per channel
+        # the headline ratio: int8 weight payload is exactly 0.25x f32
+        assert wbytes * 4 == 64 * 256 * 4
+
+
+# ---------------------------------------------------------- scale specs
+
+class TestScaleShardingSpecs:
+    @pytest.mark.parametrize("name", [
+        "word_embed", "logit_w", "lstm0_w", "lstm1_w", "att_wf", "att_wh",
+    ])
+    def test_scale_spec_follows_weight_channel_axis(self, name):
+        """The ``*_scale`` spec is the weight spec PROJECTED onto its
+        quantization axis — sharded iff the channel dim is sharded, so
+        the post-accumulation multiply never gathers."""
+        axis = quant.quant_axis(name)
+        assert axis is not None, f"{name} is not a quantized leaf"
+        w_spec = tuple(partition.spec_for_leaf(name))
+        channel = w_spec[axis] if axis < len(w_spec) else None
+        s_spec = tuple(partition.spec_for_leaf(name + quant.SCALE_SUFFIX))
+        assert s_spec == ((channel,) if channel is not None else ()), (
+            f"{name}: weight spec {w_spec} axis {axis} vs scale "
+            f"spec {s_spec}"
+        )
+
+    def test_biases_and_vectors_are_not_quantized(self):
+        for name in ("logit_b", "lstm0_b", "att_b", "att_v",
+                     "proj_resnet_w", "cat_embed"):
+            assert quant.quant_axis(name) is None, name
+
+
+# ------------------------------------------------------------- engines
+
+def _tiny_cfg(dtype="f32"):
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.num_slots = 4
+    cfg.serving.slot_bank_min = 2
+    cfg.serving.max_batch_size = 4
+    cfg.serving.batch_shapes = [2, 4]
+    cfg.serving.dtype = dtype
+    return cfg
+
+
+def _payloads(cfg, n, seed=0):
+    rng = np.random.RandomState(seed)
+    d = cfg.data
+    return [
+        {
+            "features": {
+                m: rng.randn(d.max_frames, d.feature_dims[m]).astype(
+                    np.float32
+                )
+                for m in d.feature_modalities
+            }
+        }
+        for _ in range(n)
+    ]
+
+
+def _captions(engine, payloads):
+    reqs = [engine.prepare(dict(p)) for p in payloads]
+    out = []
+    step = engine.cfg.serving.max_batch_size
+    for i in range(0, len(reqs), step):
+        out += [
+            r.caption
+            for r in engine.decode_prepared(reqs[i:i + step], store=False)
+        ]
+    return out
+
+
+def _beam_scores(engine, payloads):
+    """Length-normalized beam scores of the served caption per payload
+    (the per-caption score the relaxed-serving gap bound is pinned on)."""
+    cfg = engine.cfg
+    reqs = [engine.prepare(dict(p)) for p in payloads]
+    feats = {
+        m: jnp.asarray(np.stack([r.feats[m] for r in reqs]))
+        for m in reqs[0].feats
+    }
+    masks = {
+        m: jnp.asarray(np.stack([r.masks[m] for r in reqs]))
+        for m in reqs[0].masks
+    }
+    fn = make_beam_search_fn(
+        engine.model,
+        beam_size=cfg.eval.beam_size,
+        max_len=cfg.eval.max_decode_len,
+        length_normalize=cfg.eval.length_normalize,
+    )
+    return np.asarray(fn(engine.params, feats, masks).score, np.float64)
+
+
+@pytest.fixture(scope="module")
+def dtype_world():
+    """One vocab, one random init, three serving dtypes (plus a
+    knob-free baseline for the byte-identity pin)."""
+    vocab = Vocabulary([f"w{i}" for i in range(60)])
+
+    def mk(dtype):
+        cfg = _tiny_cfg(dtype)
+        cfg.model.vocab_size = len(vocab)
+        return InferenceEngine(cfg, random_init=True, vocab=vocab)
+
+    baseline_cfg = get_preset("synthetic_smoke")
+    baseline_cfg.serving.warmup = False
+    baseline_cfg.serving.num_slots = 4
+    baseline_cfg.serving.slot_bank_min = 2
+    baseline_cfg.serving.max_batch_size = 4
+    baseline_cfg.serving.batch_shapes = [2, 4]
+    baseline_cfg.model.vocab_size = len(vocab)
+    baseline = InferenceEngine(baseline_cfg, random_init=True, vocab=vocab)
+    return {
+        "baseline": baseline,
+        "f32": mk("f32"),
+        "bf16": mk("bf16"),
+        "int8w": mk("int8w"),
+    }
+
+
+class TestServingDtypeEngines:
+    def test_unknown_dtype_is_a_named_error(self):
+        cfg = _tiny_cfg("fp8")
+        with pytest.raises(ValueError, match="serving.dtype"):
+            InferenceEngine(cfg, random_init=True)
+
+    def test_f32_knob_is_byte_identical(self, dtype_world):
+        """serving.dtype="f32" changes NOTHING: same bytes, same
+        params_tag (tier-1/2 cache keys keep hitting), no scale
+        leaves, identical captions."""
+        base, f32 = dtype_world["baseline"], dtype_world["f32"]
+        assert f32.serving_dtype == "f32"
+        assert f32.params_tag == base.params_tag
+        assert "|dt" not in f32.params_tag
+        bl = jax.tree_util.tree_leaves_with_path(base.params)
+        fl = jax.tree_util.tree_leaves_with_path(f32.params)
+        assert len(bl) == len(fl)
+        for (bp, bv), (fp, fv) in zip(bl, fl):
+            assert partition.path_str(bp) == partition.path_str(fp)
+            assert not partition.path_str(fp).endswith(quant.SCALE_SUFFIX)
+            assert bv.dtype == fv.dtype
+            assert np.array_equal(np.asarray(bv), np.asarray(fv))
+        p = _payloads(f32.cfg, 4)
+        assert _captions(f32, p) == _captions(base, p)
+
+    def test_int8w_quantizes_the_published_leaves(self, dtype_world):
+        e = dtype_world["int8w"]
+        p = e.params["params"] if "params" in e.params else e.params
+        assert e.serving_dtype == "int8w"
+        assert e.params_tag.endswith("|dtint8w")
+        assert quant.is_quantized(e.params)
+        assert p["logit_w"].dtype == jnp.int8
+        assert p["word_embed"].dtype == jnp.int8
+        assert p["logit_w_scale"].dtype == jnp.float32
+        assert p["logit_b"].dtype == jnp.float32
+        # honest byte accounting: quantized residency really shrinks
+        f32_bytes = dtype_world["f32"].param_bytes_per_shard()
+        int8_bytes = e.param_bytes_per_shard()
+        assert int8_bytes < 0.6 * f32_bytes
+        assert e.fingerprint()["serving_dtype"] == "int8w"
+        assert e.describe()["serving_dtype"] == "int8w"
+        assert e.describe()["param_bytes_per_shard"] == int8_bytes
+        assert e.slot_decoder().describe()["serving_dtype"] == "int8w"
+
+    @pytest.mark.parametrize("dtype", ["bf16", "int8w"])
+    def test_relaxed_serving_parity_bounds(self, dtype_world, dtype):
+        """THE relaxed-serving contract (docs/PARITY.md r17), machine
+        checked on the fixed eval set: caption-match rate vs f32 >=
+        the pinned floor, per-caption beam-score gap <= the pinned
+        rtol.  The same bounds gate the lowprec_* bench rows BEFORE
+        they record."""
+        f32, low = dtype_world["f32"], dtype_world[dtype]
+        payloads = _payloads(f32.cfg, 8)
+        ref = _captions(f32, payloads)
+        got = _captions(low, payloads)
+        match = sum(a == b for a, b in zip(ref, got)) / len(ref)
+        assert match >= RELAXED_SERVING_MATCH_FLOOR, (
+            f"{dtype}: caption-match rate {match:.3f} below the pinned "
+            f"floor {RELAXED_SERVING_MATCH_FLOOR}"
+        )
+        s_ref = _beam_scores(f32, payloads)
+        s_low = _beam_scores(low, payloads)
+        gap = np.abs(s_low - s_ref) / np.maximum(np.abs(s_ref), 1e-6)
+        assert float(gap.max()) <= RELAXED_SERVING_SCORE_RTOL, (
+            f"{dtype}: max per-caption score gap {gap.max():.4f} above "
+            f"the pinned rtol {RELAXED_SERVING_SCORE_RTOL}"
+        )
+
+
+# ----------------------------------------------------- quantized artifact
+
+@pytest.fixture(scope="module")
+def int8w_artifact(dtype_world, tmp_path_factory):
+    engine = dtype_world["int8w"]
+    root = str(tmp_path_factory.mktemp("int8w_artifacts"))
+    summary = build_artifact(engine, root)
+    return engine, summary
+
+
+def _decode_all(engine, decoder, payloads):
+    reqs = [engine.prepare(dict(p)) for p in payloads]
+    pending = list(enumerate(reqs))
+    got = {}
+    while pending or decoder.occupied:
+        n = min(1, len(pending), len(decoder.free))
+        batch = [pending.pop(0) for _ in range(n)]
+        done = decoder.tick([r for _, r in batch], [i for i, _ in batch])
+        for i, tokens, _score, _steps in decoder.harvest_many(done):
+            got[i] = tokens
+    return [got[i] for i in range(len(payloads))]
+
+
+class TestInt8wArtifact:
+    def test_manifest_carries_lowprec_provenance(self, int8w_artifact):
+        _, summary = int8w_artifact
+        with open(os.path.join(summary["path"], MANIFEST_NAME)) as f:
+            man = json.load(f)
+        assert man["serving_dtype"] == "int8w"
+        assert man["scale_hashes"], "int8w build published no scale hashes"
+        for name in ("logit_w_scale", "word_embed_scale"):
+            assert any(k.endswith(name) for k in man["scale_hashes"]), name
+
+    def test_boot_zero_compiles_token_exact(self, int8w_artifact):
+        """Quantize ONCE at build: the artifact restores int8 codes +
+        scales directly (no boot-time requantization), compiles
+        nothing, and serves the exact warm-engine tokens."""
+        engine, summary = int8w_artifact
+        booted = InferenceEngine.from_artifact(summary["path"])
+        assert booted.serving_dtype == "int8w"
+        assert quant.is_quantized(booted.params)
+        dec = booted.slot_decoder()
+        assert dec.compile_count == 0
+        payloads = _payloads(engine.cfg, 5, seed=7)
+        warm = _decode_all(engine, engine.slot_decoder(), payloads)
+        aot = _decode_all(booted, dec, payloads)
+        for a, b in zip(warm, aot):
+            assert np.array_equal(a, b)
+        assert dec.compile_count == 0
+
+    def _tampered(self, summary, tmp_path, mutate):
+        vdir = os.path.join(str(tmp_path), "tampered")
+        shutil.copytree(summary["path"], vdir)
+        mpath = os.path.join(vdir, MANIFEST_NAME)
+        with open(mpath) as f:
+            man = json.load(f)
+        mutate(man)
+        with open(mpath, "w") as f:
+            json.dump(man, f)
+        return vdir
+
+    def test_serving_dtype_divergence_refused(
+        self, int8w_artifact, tmp_path
+    ):
+        _, summary = int8w_artifact
+
+        def flip(man):
+            man["serving_dtype"] = "f32"
+
+        vdir = self._tampered(summary, tmp_path, flip)
+        with pytest.raises(ArtifactMismatchError) as ei:
+            InferenceEngine.from_artifact(vdir)
+        assert any(f == "serving_dtype" for f, _, _ in ei.value.mismatches)
+
+    def test_scale_hash_drift_refused(self, int8w_artifact, tmp_path):
+        _, summary = int8w_artifact
+
+        def drift(man):
+            key = sorted(man["scale_hashes"])[0]
+            man["scale_hashes"][key] = "0" * 16
+
+        vdir = self._tampered(summary, tmp_path, drift)
+        with pytest.raises(ArtifactMismatchError) as ei:
+            InferenceEngine.from_artifact(vdir)
+        assert any(f == "scale_hashes" for f, _, _ in ei.value.mismatches)
