@@ -232,6 +232,29 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
                     f"{k!r} must be a caption-match fraction in "
                     f"[0, 1], got {v!r}"
                 )
+        # Speculative-decode rows (ISSUE 18): every spec_* field is a
+        # measurement by contract — numeric, never bool/None/prose
+        # (the paired spec/baseline rows are only comparable when both
+        # arms really served at matched load, and the token-exactness
+        # claim rides on spec_token_mismatches being a REAL count that
+        # was asserted 0 before emit, not a True that leaked from a
+        # comparison).  spec_acceptance_rate is a fraction in [0, 1];
+        # the provenance string fields keep their own formats.
+        for k, v in rec["extra"].items():
+            if not k.startswith("spec_"):
+                continue
+            if k.endswith(("_mesh_shape", "_xla_flags",
+                           "_jax_platforms")):
+                continue
+            if isinstance(v, bool):
+                fail(f"{k!r} must be a real number, got a bool")
+            if not _is_number(v):
+                fail(f"{k!r} must be a real number, got {v!r}")
+            if "acceptance_rate" in k and not (0.0 <= v <= 1.0):
+                fail(
+                    f"{k!r} must be an acceptance fraction in [0, 1], "
+                    f"got {v!r}"
+                )
         # Mesh topology is a machine-readable string by contract
         # (ISSUE 9): any *_mesh_shape field must look like "2x4" —
         # axis sizes joined by "x" in declared axis order.  A bool,
@@ -1734,6 +1757,32 @@ def _bench_slo_impl():
     ref_wall = time.perf_counter() - t0
     ref_att = ref.attainment(ref_slo_ticks)
 
+    # ---- attainment curve (ISSUE 18): the SAME healthy fleet at three
+    # offered-load points (base_per_tick 0.5 / 2.0 / 4.0, no chaos) —
+    # the knee of attainment-vs-load is what capacity planning reads,
+    # and a single reference point can't show it.  The 0.5 point IS the
+    # reference scenario above (same trace parameters), so it re-uses
+    # that run instead of soaking twice.  The curve's latency bound is
+    # tighter than the gate's (queueing delay, not just service time):
+    # at the reference bound every point saturates at 1.0 and the knee
+    # is invisible.
+    curve_slo_ticks = 20
+    curve = {}
+    for tag, load in (("050", 0.5), ("200", 2.0), ("400", 4.0)):
+        if load == 0.5:
+            rep = ref
+        else:
+            trace = make_diurnal_trace(
+                seed, n_reqs, n_keys,
+                base_per_tick=load, burst_factor=1.0,
+            )
+            rep = run_soak(fresh_rs(queue_depth=256), payloads, trace)
+        curve[f"slo_attainment_curve_load{tag}"] = round(
+            rep.attainment(curve_slo_ticks)["overall"], 4
+        )
+        curve[f"slo_curve_served_load{tag}"] = float(rep.served)
+    curve["slo_curve_slo_ticks"] = float(curve_slo_ticks)
+
     # ---- chaos scenario: diurnal burst + mid-traffic chaos, overload
     chaos_schedule = [
         {"site": "replica_kill", "at": 8, "replica": 0},
@@ -1771,6 +1820,8 @@ def _bench_slo_impl():
         "slo_reference_ticks": float(ref.ticks),
         "slo_reference_wall_s": round(ref_wall, 2),
         "slo_reference_lost": float(ref.lost),
+        "slo_curve_points": 3.0,
+        **curve,
         "slo_chaos_attainment_overall": round(att["overall"], 4),
         "slo_chaos_attainment_interactive": round(
             att.get("interactive", 0.0), 4
@@ -3159,6 +3210,233 @@ def bench_lowprec(backend_ok: bool = True):
     return out
 
 
+def _bench_spec_impl():
+    """Speculative-decode serving rows (the in-process child of
+    :func:`bench_spec`; ISSUE 18).
+
+    One random init, one fixed request stream, two slot decoders on the
+    SAME weights — plain greedy vs ``serving.speculative`` — driven
+    through the identical admit/tick/harvest loop at matched offered
+    load.  Two gates run BEFORE anything records:
+
+    * **token-exactness** — every harvested token array from the
+      speculative arm must equal the plain arm's byte-for-byte
+      (``spec_token_mismatches`` is asserted 0; the rejection rule
+      makes this an invariant, so a nonzero count is a bug, not noise).
+    * **speedup floor** — mean emitted tokens per live slot-round must
+      beat 1.0 (the non-speculative floor); a draft that never gets a
+      token accepted must not record as a win.
+
+    The draft is distilled IN the child against the request pool's own
+    teacher streams (the ``cli/distill_draft.py`` update step, a few
+    hundred Adam steps on a tiny pool — memorization is the point:
+    acceptance on this pool stands in for a distilled draft's
+    acceptance on its serving distribution).  Virtual-CPU captions/s
+    are not TPU captions/s; ``spec_host_cores``/``spec_mesh_shape``
+    provenance keeps the rows caveated from the record alone."""
+    import copy
+    import shutil
+    import tempfile
+
+    import optax
+
+    from cst_captioning_tpu.cli.distill_draft import _make_update
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+    from cst_captioning_tpu.data.vocab import Vocabulary
+    from cst_captioning_tpu.decoding.speculative import (
+        make_draft_params,
+        save_draft_params,
+    )
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    k = int(os.environ.get("BENCH_SPEC_K", "4"))
+    steps = int(os.environ.get("BENCH_SPEC_STEPS", "200"))
+    lr = float(os.environ.get("BENCH_SPEC_LR", "0.003"))
+    n_reqs = int(os.environ.get("BENCH_SPEC_REQS", "48"))
+    n_pool = int(os.environ.get("BENCH_SPEC_POOL", "4"))
+
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.decode_mode = "greedy"     # spec is greedy-only
+    cfg.serving.num_slots = 4
+    cfg.serving.slot_block_steps = 1       # 1 token/slot-tick floor
+    cfg.serving.dedup_cache = False        # pool keys repeat on purpose
+    vocab = Vocabulary([f"w{i}" for i in range(252)])
+    cfg.model.vocab_size = len(vocab)
+    base = InferenceEngine(cfg, random_init=True, vocab=vocab)
+
+    rng = np.random.RandomState(20260807)
+    F = cfg.data.max_frames
+    pool = [
+        {
+            "features": {
+                m: rng.randn(F, d).astype(np.float32)
+                for m, d in cfg.data.feature_dims.items()
+            }
+        }
+        for _ in range(n_pool)
+    ]
+
+    # ---- teacher streams for the pool (the full model's greedy
+    # tokens), then distill the draft to memorize them
+    T = int(cfg.eval.max_decode_len)
+    reqs = [base.prepare(dict(p)) for p in pool]
+    feats = {
+        m: jnp.asarray(np.stack([r.feats[m] for r in reqs]))
+        for m in reqs[0].feats
+    }
+    masks = {
+        m: jnp.asarray(np.stack([r.masks[m] for r in reqs]))
+        for m in reqs[0].masks
+    }
+    state, cache = base.model.apply(
+        base.params, feats, masks, None, method="init_decode"
+    )
+    tok = jnp.full((n_pool,), BOS_ID, jnp.int32)
+    finished = jnp.zeros((n_pool,), bool)
+    cols = [tok]
+    for _ in range(T):
+        state, logits = base.model.apply(
+            base.params, state, cache, tok, method="decode_logits"
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        col = jnp.where(finished, PAD_ID, nxt)
+        cols.append(col)
+        finished = finished | (col == EOS_ID)
+        tok = jnp.where(finished, EOS_ID, col)
+    seqs = jnp.stack(cols, axis=1)
+
+    p = base.params["params"] if "params" in base.params else base.params
+    hd = int(os.environ.get("BENCH_SPEC_HIDDEN", "0")) or min(
+        p["word_embed"].shape[1], p["logit_w"].shape[0]
+    )
+    dp = {k2: jnp.asarray(v) for k2, v in
+          make_draft_params(base.params, hd).items()}
+    opt = optax.adam(lr)
+    opt_state = opt.init(dp)
+    update = _make_update(opt, bool(base.model.decode_suppress_unk))
+    agree = None
+    for _ in range(steps):
+        dp, opt_state, _loss, agree = update(dp, opt_state, seqs)
+    teacher_match = float(jax.device_get(agree))
+
+    tmp = tempfile.mkdtemp(prefix="bench_spec_draft_")
+    try:
+        draft_path = os.path.join(tmp, "draft.npz")
+        save_draft_params(draft_path, dp)
+        c = copy.deepcopy(cfg)
+        c.serving.speculative = {
+            "draft_k": k, "draft_hidden": hd,
+            "draft_params": draft_path,
+        }
+        spec_eng = InferenceEngine(c, params=base.params, vocab=vocab)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    # ---- matched-load drive: same request stream, same loop
+    def drive(eng):
+        dec = eng.slot_decoder()
+        dec.warmup()
+        pending = [
+            (i, eng.prepare(dict(pool[i % n_pool])))
+            for i in range(n_reqs)
+        ]
+        got = {}
+        tick_s = []
+        t0 = time.perf_counter()
+        while pending or dec.occupied:
+            n = min(len(pending), len(dec.free), dec.admit_cap)
+            batch = [pending.pop(0) for _ in range(n)]
+            tt = time.perf_counter()
+            done = dec.tick(
+                [r for _, r in batch], [i for i, _ in batch]
+            )
+            tick_s.append(time.perf_counter() - tt)
+            for i, tokens, _score, _steps in dec.harvest_many(done):
+                got[i] = np.asarray(tokens)
+        wall = time.perf_counter() - t0
+        tick_s.sort()
+        p99 = tick_s[min(len(tick_s) - 1, int(len(tick_s) * 0.99))]
+        return got, wall, len(tick_s), p99, dec
+
+    got_base, wall_base, ticks_base, p99_base, _ = drive(base)
+    got_spec, wall_spec, ticks_spec, p99_spec, dec_spec = drive(spec_eng)
+
+    # ---- gate 1: token-exactness, asserted BEFORE recording
+    mismatches = sum(
+        1 for i in range(n_reqs)
+        if not np.array_equal(got_spec[i], got_base[i])
+    )
+    if mismatches:
+        raise RuntimeError(
+            f"speculative decode diverged on {mismatches}/{n_reqs} "
+            "requests — token-exactness is the contract "
+            "(docs/PARITY.md r18); not recording perf for wrong tokens"
+        )
+    stats = dec_spec.spec_stats()
+    # ---- gate 2: the speedup floor — >1 token per live slot-round
+    if stats["tokens_per_round"] <= 1.0:
+        raise RuntimeError(
+            f"speculation emitted {stats['tokens_per_round']:.3f} "
+            "tokens per live slot-round — no better than the "
+            "non-speculative floor; not recording as a win"
+        )
+
+    return {
+        "spec_host_cores": float(os.cpu_count() or 1),
+        "spec_xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "spec_jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "spec_mesh_shape": spec_eng.describe()["mesh_shape"],
+        "spec_draft_k": float(k),
+        "spec_draft_hidden": float(hd),
+        "spec_distill_steps": float(steps),
+        "spec_distill_teacher_match": round(teacher_match, 4),
+        "spec_requests": float(n_reqs),
+        "spec_pool_keys": float(n_pool),
+        "spec_token_mismatches": float(mismatches),
+        "spec_acceptance_rate": round(stats["acceptance_rate"], 4),
+        "spec_tokens_per_tick": round(stats["tokens_per_round"], 4),
+        "spec_emitted_tokens": stats["emitted_tokens"],
+        "spec_live_slot_rounds": stats["live_slot_rounds"],
+        "spec_captions_per_sec": round(n_reqs / wall_spec, 3),
+        "spec_baseline_captions_per_sec": round(n_reqs / wall_base, 3),
+        "spec_vs_baseline_ratio": round(wall_base / wall_spec, 4),
+        "spec_ticks": float(ticks_spec),
+        "spec_baseline_ticks": float(ticks_base),
+        "spec_p99_tick_ms": round(p99_spec * 1e3, 3),
+        "spec_baseline_p99_tick_ms": round(p99_base * 1e3, 3),
+    }
+
+
+def bench_spec():
+    """Speculative-decode rows (see :func:`_bench_spec_impl`).
+    Re-execs into a CPU subprocess (the bench_slo precedent): the
+    distill loop + paired drive target the smoke shape and must not
+    disturb a TPU-held parent."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_SPEC_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"spec decode child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    return json.loads(lines[-1])
+
+
 def bench_loader():
     """Host batch assembly from the packed feature store at MSR-VTT shape
     (B=64 videos, 28 frames, resnet-2048 + c3d-4096, float16 on disk).
@@ -3333,6 +3611,27 @@ def main() -> int:
     errors = {}
     state = {"sps_chip": None}
 
+    # ISSUE 18 satellite: BENCH_ONLY=<prefix> (or ``--only <prefix>`` on
+    # the command line) narrows the run to sub-bench families whose
+    # name starts with the prefix, case-insensitive — BENCH_ONLY=spec
+    # runs just the speculative rows without flipping a dozen BENCH_*
+    # switches off by hand.  The per-family BENCH_<NAME>=0 kill
+    # switches still win, and the active filter is recorded in the row
+    # (``bench_only``) so a narrowed artifact can never masquerade as
+    # a full run.
+    only = os.environ.get("BENCH_ONLY", "")
+    if "--only" in sys.argv[1:]:
+        i = sys.argv.index("--only")
+        if i + 1 < len(sys.argv):
+            only = sys.argv[i + 1]
+    if only:
+        extra["bench_only"] = only
+
+    def family_on(name: str) -> bool:
+        if os.environ.get(f"BENCH_{name}", "1") != "1":
+            return False
+        return not only or name.lower().startswith(only.lower())
+
     # PR 8: invariant-engine preflight.  The pure-AST pass costs ~2s, so
     # a bench run never measures a tree that violates the machine-checked
     # contracts (docs/ANALYSIS.md) without the record SAYING so — the
@@ -3428,7 +3727,10 @@ def main() -> int:
     # then the full-chunk measurement replaces it.
     first_chunk = int(os.environ.get("BENCH_FIRST_CHUNK", "12"))
     sps_chip = tflops = None
-    if ok:
+    # The headline rides the BENCH_ONLY filter too (family name "xe"):
+    # a narrowed run skips straight to the selected sub-bench, leaving
+    # value=null — the recorded bench_only says why.
+    if ok and family_on("XE"):
         try:
             sps_first, tflops = bench_xe(chunk=first_chunk)
             sps_chip = sps_first
@@ -3467,7 +3769,7 @@ def main() -> int:
         if "cpu" not in dev.platform:
             extra["xe_mfu_vs_v5e_peak"] = round(tflops / 197.0, 4)
         emit()
-    if ok and os.environ.get("BENCH_ATTN", "1") == "1":
+    if ok and family_on("ATTN"):
         # The flagship (entry()) attention-fusion model — slower than
         # meanpool by construction (per-step Bahdanau attention inside the
         # decode scan); the Pallas fused step (ops/pallas_attention.py)
@@ -3482,13 +3784,13 @@ def main() -> int:
         except Exception as e:
             extra["attn_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if ok and os.environ.get("BENCH_CST", "1") == "1":
+    if ok and family_on("CST"):
         try:
             extra.update(bench_cst())
         except Exception as e:  # CST bench must never sink the headline
             extra["cst_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_OVERLAP_SIM", "1") == "1":
+    if family_on("OVERLAP_SIM"):
         # Chunked-scoring overlap evidence (VERDICT r3 weak #2): the
         # latency gate disables chunking on tunneled runtimes, so the
         # pipeline the default config ships is demonstrated in a
@@ -3508,7 +3810,7 @@ def main() -> int:
         except Exception as e:
             extra["overlap_sim_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_CST_PIPE", "1") == "1":
+    if family_on("CST_PIPE"):
         # Paired serial-vs-pipelined CST reward-scheduling rows
         # (subprocess on the in-process CPU backend; no live backend
         # needed in this process, so it runs in degraded mode too).
@@ -3517,7 +3819,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["cst_pipe_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_CST_SLOT", "1") == "1":
+    if family_on("CST_SLOT"):
         # Paired padded-vs-slot CST rollout rows (subprocess on the
         # in-process CPU backend; degraded-mode safe like cst_pipe).
         try:
@@ -3525,13 +3827,13 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["cst_slot_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if ok and os.environ.get("BENCH_DECODE", "1") == "1":
+    if ok and family_on("DECODE"):
         try:
             extra.update(bench_decode())
         except Exception as e:
             extra["decode_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_SLOT_MEM", "1") == "1":
+    if family_on("SLOT_MEM"):
         # Paired replicated-vs-deduped decode-state memory rows
         # (subprocess on the in-process CPU backend; the byte rows are
         # deterministic pytree arithmetic — degraded-mode safe).
@@ -3540,7 +3842,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["slot_mem_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_SERVING", "1") == "1":
+    if family_on("SERVING"):
         # Serving subsystem sweep (serving/): needs a live jax backend
         # but drops to the CPU-sized shape off-TPU, so it runs in
         # degraded mode too as long as ANY backend initializes.
@@ -3549,7 +3851,7 @@ def main() -> int:
         except Exception as e:
             extra["serving_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_REPLICAS", "1") == "1":
+    if family_on("REPLICAS"):
         # Multi-replica scheduler sweep: inline on multi-device hosts,
         # re-exec'd onto a virtual multi-device CPU platform otherwise
         # — so it records 1-vs-N scaling even with the backend down.
@@ -3558,7 +3860,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["replicas_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_TRACE", "1") == "1":
+    if family_on("TRACE"):
         # Paired tracing-on/off serving rows (ISSUE 10): the span
         # layer's cost on sustained captions/s + p99, measured in a
         # CPU subprocess (degraded-mode safe) — the <=2% acceptance bar
@@ -3568,7 +3870,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["trace_bench_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_SLO", "1") == "1":
+    if family_on("SLO"):
         # Chaos soak + SLO-attainment rows (ISSUE 11): recorded-trace
         # replay against a 2-replica set with mid-traffic chaos, in a
         # CPU subprocess (degraded-mode safe).  The reference-load
@@ -3582,7 +3884,7 @@ def main() -> int:
             errors["slo_gate"] = gate_reason
             print(f"SLO GATE FAILED: {gate_reason}", file=sys.stderr)
         emit()
-    if os.environ.get("BENCH_COLDSTART", "1") == "1":
+    if family_on("COLDSTART"):
         # Paired warm-vs-AOT cold-start rows (ISSUE 13): process start
         # -> first caption served, measured on fresh subprocesses over
         # one shared artifact (CPU child; degraded-mode safe).  The
@@ -3592,7 +3894,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["coldstart_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_SHARD", "1") == "1":
+    if family_on("SHARD"):
         # Paired replicated-vs-model-sharded XE rows on a >=4-device
         # mesh (ISSUE 9): inline on multi-device hosts, re-exec'd onto
         # a virtual CPU platform otherwise — vocab-matmul collective
@@ -3603,7 +3905,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["shard_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_SHARD_FUSED", "1") == "1":
+    if family_on("SHARD_FUSED"):
         # Paired fused-vs-scan model-sharded slot-decode rows (ISSUE
         # 14): candidate-all-gather vs full-vocab-gather collective
         # bytes + steps/s under M=2 on a virtual 2-device CPU mesh,
@@ -3613,7 +3915,7 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["shard_fused_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_LOWPREC", "1") == "1":
+    if family_on("LOWPREC"):
         # Paired f32/bf16/int8w serving rows (ISSUE 16): captions/s +
         # p99 + per-shard weight bytes at matched offered load on the
         # 1-device and TP=2 grids, with the relaxed-serving parity
@@ -3624,7 +3926,18 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["lowprec_error"] = f"{type(e).__name__}: {e}"
         emit()
-    if os.environ.get("BENCH_LOADER", "1") == "1":
+    if family_on("SPEC"):
+        # Speculative-decode rows (ISSUE 18): draft-LSTM propose +
+        # full-model batched verify on the slot runtime, distilled
+        # in-child, token-exactness AND the >1 token/slot-round floor
+        # asserted before anything records (CPU subprocess;
+        # degraded-mode safe).
+        try:
+            extra.update(bench_spec())
+        except Exception as e:  # noqa: BLE001
+            extra["spec_error"] = f"{type(e).__name__}: {e}"
+        emit()
+    if family_on("LOADER"):
         # Host-only bench: runs even when the device backend is down.
         try:
             ms = bench_loader()
@@ -3646,7 +3959,7 @@ def main() -> int:
     if (
         ok
         and sps_chip is not None
-        and os.environ.get("BENCH_MATCHED", "1") == "1"
+        and family_on("MATCHED")
         and prev
     ):
         try:
@@ -3730,6 +4043,11 @@ if __name__ == "__main__":
         # (bench_shard_fused), same virtual-platform discipline.
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_shard_fused_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_SPEC_CHILD") == "1":
+        # Re-exec'd speculative-decode child (bench_spec).
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_spec_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_LOWPREC_CHILD") == "1":
         # Re-exec'd f32/bf16/int8w low-precision serving child

@@ -61,12 +61,25 @@ JIT_SITE_REGISTRY: Dict[str, JitSite] = {
         "builds every variant and SlotDecoder.compile_count pins that "
         "post-warmup traffic builds ZERO new ones (tier-1)"
     ),
+    "serving/slots.py::SlotDecoder._tick_fn.tick_spec": JitSite(
+        "speculative twin of tick: the SAME (bank S, admit bucket A) "
+        "grid — draft_k is fixed per decoder config so it never splits "
+        "the key; warmup() builds every variant and compile_count pins "
+        "zero post-warmup builds (the AOT key carries the :k suffix so "
+        "a foreign-k artifact is refused at install)"
+    ),
     "serving/slots.py::SlotDecoder._free_fn.free_rows": JitSite(
         "one compile per bank size, warmup-built, compile_count-pinned"
     ),
     "serving/slots.py::SlotDecoder._resize_fn.resize": JitSite(
         "one compile per bank-ladder transition (grow+shrink), "
         "warmup-built, compile_count-pinned"
+    ),
+    # --------------------------------------------------- speculative decode
+    "decoding/speculative.py::_greedy_spec_runner.round_fn": JitSite(
+        "offline spec parity backend: one compile at the harness's "
+        "fixed (B, k, L) shape per test run (the shared-harness "
+        "token-exact pin reuses it for every video)"
     ),
     # ---------------------------------------------------------- training
     "training/steps.py::make_xe_train_step::train_step": JitSite(
@@ -128,6 +141,12 @@ JIT_SITE_REGISTRY: Dict[str, JitSite] = {
     "training/cst.py::_make_slot_step.update_fn": JitSite(
         "one compile per power-of-two trimmed PG length bucket "
         "(identical trim to the padded layout)",
+        update_step=True,
+    ),
+    # --------------------------------------------------------------- cli
+    "cli/distill_draft.py::_make_update.update": JitSite(
+        "offline draft distillation: one compile at the fixed "
+        "(batch, max_len) distillation shape per CLI invocation",
         update_step=True,
     ),
     # ------------------------------------------------------------- tools
@@ -322,6 +341,24 @@ CAST_REGISTRY: Dict[str, CastSite] = {
         "row-keyed sampling casts the categorical draw to the carry's "
         "i32 token dtype — id plumbing on the PARITY-r10 row-keyed "
         "stream",
+    ),
+    "decoding/speculative.py::draft_step": CastSite(
+        "token-exact",
+        "draft proposal: all-f32 compute around ops/rnn.py::lstm_step "
+        "(whose casts are registered at the cell), with the argmax "
+        "winner cast to the carry's i32 token dtype — id plumbing; the "
+        "draft NEVER emits tokens, verify-side acceptance is what the "
+        "token-exact tier pins",
+    ),
+    "decoding/speculative.py::spec_round": CastSite(
+        "token-exact",
+        "the accept/emit core: bool proposal-vs-verified equality mask "
+        "-> i32 for the cumprod prefix-match count, i32 next-token "
+        "plumbing, and {0,1}/count widening to f32 for the acceptance "
+        "stats — integer/mask arithmetic on exactly-representable "
+        "values; the tier is MACHINE-pinned by the shared harness "
+        "(greedy_spec_offline + slot_decoder_greedy_spec vs "
+        "scan_greedy) and the bench's spec_token_mismatches==0 assert",
     ),
     # ------------------------------------------------------------ model
     "models/captioner.py::CaptionModel._encode": CastSite(
@@ -531,10 +568,11 @@ CAST_REGISTRY: Dict[str, CastSite] = {
         "(one engine produced both); the cast is a pytree-uniformity "
         "guard, not a precision change",
     ),
-    "serving/slots.py::SlotDecoder._tick_fn.tick": CastSite(
+    "serving/slots.py::SlotDecoder._tick_fn.admit_all": CastSite(
         "token-exact",
         "bool admit/free masks → f32 for the select over slot rows — "
-        "{0,1} exact; the staggered-admission row-exact pin covers it",
+        "{0,1} exact; the staggered-admission row-exact pin covers it "
+        "(shared by the plain and speculative tick variants)",
     ),
     # --------------------------------------------------------- training
     "training/cst.py::SlotRollout._tick_fn.tick": CastSite(
@@ -631,6 +669,19 @@ SHAPE_LADDER_REGISTRY: Dict[str, ShapeLadder] = {
          "serving/slots.py::_bank_ladder",
          "serving/slots.py::SlotDecoder.warm_admit_counts"),
     ),
+    "serving/slots.py::SlotDecoder._tick_fn.tick_spec": ShapeLadder(
+        "enumerated",
+        "the SAME (bank S, admit bucket A) grid as tick — draft_k and "
+        "draft_hidden are per-decoder constants (config-fixed), so the "
+        "spec variant family is exactly the tick family's size; warmup "
+        "compiles every variant, compile_count pins zero post-warmup "
+        "builds, and the aot key's :k<draft_k> suffix refuses a "
+        "foreign-k executable at install",
+        ("serving/slots.py::SlotDecoder._pad_bucket",
+         "serving/slots.py::_buckets",
+         "serving/slots.py::_bank_ladder",
+         "serving/slots.py::SlotDecoder.warm_admit_counts"),
+    ),
     "serving/slots.py::SlotDecoder._free_fn.free_rows": ShapeLadder(
         "enumerated",
         "one variant per bank size on the doubling ladder",
@@ -641,6 +692,12 @@ SHAPE_LADDER_REGISTRY: Dict[str, ShapeLadder] = {
         "one variant per adjacent bank transition, both directions, "
         "all warmup-compiled",
         ("serving/slots.py::_bank_ladder",),
+    ),
+    # --------------------------------------------------- speculative decode
+    "decoding/speculative.py::_greedy_spec_runner.round_fn": ShapeLadder(
+        "fixed",
+        "offline parity backend: one (B, k, L) shape per harness run "
+        "(k and L are harness constants)",
     ),
     # ---------------------------------------------------------- training
     "training/steps.py::make_xe_train_step::train_step": ShapeLadder(
@@ -701,6 +758,12 @@ SHAPE_LADDER_REGISTRY: Dict[str, ShapeLadder] = {
         "the same pow2 length-trim buckets as the split-step update",
         ("training/cst.py::_make_slot_step._trim_len",),
     ),
+    # --------------------------------------------------------------- cli
+    "cli/distill_draft.py::_make_update.update": ShapeLadder(
+        "fixed",
+        "one (batch, max_len) distillation shape per CLI invocation "
+        "(both are argparse constants)",
+    ),
     # ------------------------------------------------------------- tools
     "tools/overlap_sim.py::simulate::<lambda>": ShapeLadder(
         "fixed",
@@ -738,5 +801,12 @@ SHARDING_CONSTRAINT_REGISTRY: Dict[str, str] = {
         "vocab-over-model through the step so the logit matmul stays "
         "sharded up to the top-K/argmax instead of all-gathering every "
         "step (docs/PERF.md r12)"
+    ),
+    "serving/slots.py::SlotDecoder._build_step.spec_once.verify_fn": (
+        "speculative verify: pins the batched (k*rows, V) verify "
+        "logits vocab-over-model — the ONE big GEMM the spec round "
+        "amortizes its k steps into — so the tp_row_pick merge sees "
+        "sharded tiles instead of an all-gathered (k*rows, V) logits "
+        "block every round (the step_logits pin's k-row twin)"
     ),
 }

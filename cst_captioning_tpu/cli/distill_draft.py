@@ -1,0 +1,211 @@
+"""Distill the speculative-decode draft LSTM (the quality path):
+
+  python -m cst_captioning_tpu.cli.distill_draft \\
+      --preset msrvtt_serve_beam5 --serving.decode_mode greedy \\
+      --checkpoint checkpoints/msrvtt_cst_ms_scb/best \\
+      --out drafts/msrvtt_draft.npz --draft-hidden 128
+
+The draft ships with truncation init for free
+(``decoding/speculative.py::make_draft_params``); this CLI buys
+acceptance rate on top by teacher-forcing the draft against the FULL
+model's own greedy token stream — the exact stream the verify pass
+argmaxes, so the distillation loss directly optimizes the quantity
+speculation pays for (P[draft argmax == model argmax | shared prefix]).
+Correctness never depends on it: the rejection rule pins emitted tokens
+to the full model regardless of draft quality (docs/PARITY.md r18).
+
+Teacher rollouts run on synthetic feature batches shaped by the config
+(the same request geometry serving sees); pass a real checkpoint for a
+deployable draft or ``--random-init`` to exercise the pipeline.  Output
+is the ``.npz`` the ``serving.speculative.draft_params`` knob points at
+(key set validated at engine boot).  Prints one JSON line: final loss,
+teacher-match rate before/after, step count, output path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+
+from cst_captioning_tpu.config import parse_cli
+
+
+def _make_update(opt, suppress_unk: bool):
+    """Jitted distillation step: teacher-forced XE of the draft stream
+    against the teacher's greedy tokens, Adam update, plus the
+    greedy-agreement rate (the acceptance proxy) as a side metric."""
+    import jax
+    import jax.numpy as jnp
+
+    from cst_captioning_tpu.constants import PAD_ID
+    from cst_captioning_tpu.decoding.speculative import draft_logits
+
+    def loss_fn(dp, seqs):
+        # seqs (B, T+1): BOS column then the teacher's greedy tokens,
+        # PAD after EOS.  Feed seqs[:, :-1], predict seqs[:, 1:].
+        B = seqs.shape[0]
+        hd = dp["draft_cell_b"].shape[0] // 4
+        tgt = seqs[:, 1:].T                           # (T, B)
+        mask = (tgt != PAD_ID).astype(jnp.float32)    # EOS kept, pads out
+
+        def step(carry, tok):
+            carry, logits = draft_logits(dp, carry, tok, suppress_unk)
+            return carry, logits
+
+        _, logits = jax.lax.scan(
+            step, jnp.zeros((2, B, hd), jnp.float32), seqs[:, :-1].T
+        )                                             # (T, B, V)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, tgt[:, :, None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(mask), 1.0)
+        loss = -jnp.sum(ll * mask) / denom
+        agree = (jnp.argmax(logits, axis=-1) == tgt).astype(jnp.float32)
+        return loss, jnp.sum(agree * mask) / denom
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def update(dp, opt_state, seqs):
+        (loss, agree), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(dp, seqs)
+        updates, opt_state = opt.update(grads, opt_state, dp)
+        import optax
+
+        return optax.apply_updates(dp, updates), opt_state, loss, agree
+
+    return update
+
+
+def _teacher_batch(engine, rng, batch: int, max_len: int):
+    """One synthetic batch + the full model's greedy stream over it:
+    ``seqs`` (B, max_len+1) int32, BOS column first, PAD after EOS.
+    Eager per-step apply — this is an offline tool, not a serving path."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
+
+    d = engine.cfg.data
+    feats = {
+        m: jnp.asarray(
+            rng.standard_normal(
+                (batch, d.max_frames, d.feature_dims[m])
+            ).astype(np.float32)
+        )
+        for m in d.feature_modalities
+    }
+    masks = {
+        m: jnp.ones((batch, d.max_frames), jnp.float32) for m in feats
+    }
+    cat = (
+        jnp.asarray(rng.integers(0, 20, (batch,)).astype(np.int32))
+        if engine.model.use_category
+        else None
+    )
+    state, cache = engine.model.apply(
+        engine.params, feats, masks, cat, method="init_decode"
+    )
+    tok = jnp.full((batch,), BOS_ID, jnp.int32)
+    finished = jnp.zeros((batch,), bool)
+    cols = [tok]
+    for _ in range(max_len):
+        state, logits = engine.model.apply(
+            engine.params, state, cache, tok, method="decode_logits"
+        )
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        col = jnp.where(finished, PAD_ID, nxt)
+        cols.append(col)
+        finished = finished | (col == EOS_ID)
+        # The dead-row feed rule the serving loop uses (EOS after EOS).
+        tok = jnp.where(finished, EOS_ID, col)
+    return jnp.stack(cols, axis=1)
+
+
+def main(argv=None) -> int:
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser(add_help=False)
+    parser.add_argument("--checkpoint", default="")
+    parser.add_argument(
+        "--random-init", action="store_true",
+        help="distill against random weights (pipeline smoke only)",
+    )
+    parser.add_argument("--out", required=True, help="output .npz path")
+    parser.add_argument("--draft-hidden", type=int, default=128)
+    parser.add_argument("--steps", type=int, default=200)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--max-len", type=int, default=0,
+                        help="teacher rollout length (0 = data.max_seq_len)")
+    parser.add_argument("--seed", type=int, default=0)
+    known, rest = parser.parse_known_args(argv)
+    cfg = parse_cli(rest)
+    if not known.checkpoint and not known.random_init:
+        print(
+            "distill_draft: need --checkpoint PATH (or --random-init "
+            "for a pipeline smoke run)",
+            file=sys.stderr,
+        )
+        return 2
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from cst_captioning_tpu.decoding.speculative import (
+        make_draft_params,
+        save_draft_params,
+    )
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    # The engine is just the checkpoint/vocab/quantization loader here —
+    # no serving warmup, no slot decoder.
+    cfg.serving.warmup = False
+    cfg.serving.continuous = False
+    engine = InferenceEngine(
+        cfg, checkpoint=known.checkpoint, random_init=known.random_init
+    )
+    max_len = known.max_len or int(cfg.data.max_seq_len)
+    suppress = bool(engine.model.decode_suppress_unk)
+
+    dp = {
+        k: jnp.asarray(v)
+        for k, v in make_draft_params(
+            engine.params, known.draft_hidden
+        ).items()
+    }
+    opt = optax.adam(known.lr)
+    opt_state = opt.init(dp)
+    update = _make_update(opt, suppress)
+
+    rng = np.random.default_rng(known.seed)
+    loss = agree = agree0 = None
+    for step in range(known.steps):
+        seqs = _teacher_batch(engine, rng, known.batch, max_len)
+        dp, opt_state, loss, agree = update(dp, opt_state, seqs)
+        if agree0 is None:
+            agree0 = float(jax.device_get(agree))
+        if step % 50 == 0:
+            logging.info(
+                "step %d: loss %.4f, teacher-match %.3f",
+                step, float(jax.device_get(loss)),
+                float(jax.device_get(agree)),
+            )
+    save_draft_params(known.out, dp)
+    print(json.dumps({
+        "out": known.out,
+        "steps": known.steps,
+        "draft_hidden": known.draft_hidden,
+        "final_loss": float(jax.device_get(loss)),
+        "teacher_match_first": agree0,
+        "teacher_match_final": float(jax.device_get(agree)),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -443,6 +443,27 @@ class ServingConfig:
     # rtol — analysis/jit_registry.py constants, docs/PARITY.md r17).
     # Serving-only: the trainer never reads this knob.
     dtype: str = "f32"
+    # int8w weight calibration (ops/quant.py::quantize_per_channel):
+    # "absmax" = per-channel abs-max scaling (the PR-16 behavior,
+    # byte-identical); "percentile" clips each channel at its 99.9th
+    # |w| percentile before rounding — outlier channels trade a little
+    # clipping error for finer resolution on the bulk of the weights.
+    # Read once at quantize time (engine boot / artifact build); the
+    # chosen scales travel with the quantized tree, so replicas and
+    # AOT loads never re-calibrate.
+    quant_calibration: str = "absmax"
+    # Speculative decode on the slot runtime (decoding/speculative.py;
+    # docs/SERVING.md "Speculative decode").  Empty dict = OFF: no
+    # draft model is built and the slot decoder is byte-identical to a
+    # speculation-free build.  Keys: "draft_k" (proposals per tick,
+    # >= 2), "draft_hidden" (draft LSTM width, < model.rnn_size;
+    # default 128), "draft_params" (optional .npz from
+    # cli/distill_draft.py — absent means truncation-init from the
+    # full checkpoint).  Greedy-only; the rejection rule keeps the
+    # emitted stream token-exact vs non-speculative greedy
+    # (docs/PARITY.md r18), so the knob can only change throughput,
+    # never captions.
+    speculative: Dict[str, Any] = field(default_factory=dict)
     warmup: bool = True           # pre-jit the whole ladder at startup
 
 

@@ -491,6 +491,7 @@ _BACKENDS: Dict[str, Backend] = {}
 # harness (and the single-definition-site guard) imports them all.
 _BACKEND_MODULES = (
     "cst_captioning_tpu.decoding.beam",
+    "cst_captioning_tpu.decoding.speculative",
     "cst_captioning_tpu.models.captioner",
     "cst_captioning_tpu.ops.pallas_beam",
     "cst_captioning_tpu.ops.pallas_sampler",

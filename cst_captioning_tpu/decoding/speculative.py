@@ -1,0 +1,409 @@
+"""Speculative greedy decode: draft-LSTM propose, full-model verify.
+
+Every caption the slot runtime (serving/slots.py) serves pays one full
+decode step — one vocab-sized GEMM — per emitted token.  This module
+removes that 1:1 coupling for greedy serving: a tiny draft LSTM
+(``serving.speculative.draft_hidden`` units, vs the full model's
+``rnn_size``) proposes ``draft_k`` greedy tokens from its own cheap
+carry, and the full model verifies ALL of them in one round —
+``CaptionModel.decode_verify`` chains the k (cheap, hidden-sized)
+recurrence steps but batches the k dominant vocab projections into ONE
+(k*G, H) @ (H, V) GEMM.  The accepted prefix is the longest run where
+the draft's proposals equal the full model's own argmax stream, plus
+the model's next token after the first disagreement — the standard
+speculative rejection rule, which makes the emitted token sequence
+BIT-IDENTICAL to non-speculative greedy decode: every emitted token is
+the full model's argmax computed from exactly the decode state the
+non-speculative loop would have had (docs/PARITY.md r18; pinned by the
+shared harness backends ``greedy_spec_offline`` /
+``slot_decoder_greedy_spec`` and the bench's ``spec_token_mismatches``
+assert).  The draft can only change HOW MANY rounds a caption takes
+(acceptance rate), never which tokens come out.
+
+The draft is deliberately trivial: a single LSTM layer over the word
+embedding alone (no attention context, no category — dropping them
+costs acceptance rate, not correctness), initialized by TRUNCATING the
+full checkpoint (:func:`make_draft_params`): the first ``draft_hidden``
+embedding columns, the matching row/column slices of the layer-0 LSTM
+gates, the first ``draft_hidden`` rows of the vocab projection.  The
+quality path is ``cli/distill_draft.py``, which distills the same
+shapes against the full model's greedy stream offline and saves an
+``.npz`` the ``serving.speculative.draft_params`` knob points at.
+
+The propose/verify round itself (:func:`spec_round`) is a pure function
+over ``decoding/core.py``'s ``CoreState`` plus a (2, G, draft_hidden)
+draft carry, so the offline harness backend and the slot runtime share
+one definition; the TP cross-shard argmax merge composes through the
+same ``pick_fn`` hook ``decode_step`` grew in PR 14 (the verify logits
+are flat (k*G, V), exactly the 2-D shape ``make_tp_row_pick`` and the
+TP logits sharding constraint already handle).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cst_captioning_tpu.constants import EOS_ID
+from cst_captioning_tpu.decoding.core import (
+    CoreState,
+    DecodeState,
+    init_core,
+    register_backend,
+)
+from cst_captioning_tpu.ops import quant
+from cst_captioning_tpu.ops.rnn import LSTMWeights, lstm_step
+
+log = logging.getLogger(__name__)
+
+# The draft tree's leaf names.  They must NOT collide (by re.search)
+# with any full-model leaf pattern in parallel/partition.py's rule
+# table or ops/quant.py's axis rules — "draft_head_w" deliberately
+# avoids the "logit_w$" suffix, "draft_cell_w" the "lstm\d+_w$" one.
+DRAFT_LEAVES = (
+    "draft_embed",
+    "draft_cell_w",
+    "draft_cell_b",
+    "draft_head_w",
+    "draft_head_b",
+)
+
+DEFAULT_DRAFT_K = 4
+DEFAULT_DRAFT_HIDDEN = 128
+
+
+class SpecConfig(NamedTuple):
+    """Parsed/validated ``serving.speculative`` section."""
+
+    draft_k: int                  # proposals verified per round (>= 2)
+    draft_hidden: int             # draft LSTM width (< full rnn_size)
+    draft_params: str             # optional distilled-.npz path ("")
+
+
+def spec_config(serving_cfg) -> Optional[SpecConfig]:
+    """Parse ``serving.speculative`` (a dict knob like ``chaos`` /
+    ``autoscale``: empty = OFF, unknown keys rejected).  Returns None
+    when speculation is off."""
+    raw = dict(getattr(serving_cfg, "speculative", None) or {})
+    if not raw:
+        return None
+    unknown = set(raw) - {"draft_k", "draft_hidden", "draft_params"}
+    if unknown:
+        raise ValueError(
+            f"unknown serving.speculative key(s) {sorted(unknown)} — "
+            "expected draft_k / draft_hidden / draft_params"
+        )
+    k = int(raw.get("draft_k", DEFAULT_DRAFT_K))
+    hidden = int(raw.get("draft_hidden", DEFAULT_DRAFT_HIDDEN))
+    path = str(raw.get("draft_params", "") or "")
+    if k < 2:
+        raise ValueError(
+            f"serving.speculative.draft_k = {k} — speculation needs at "
+            "least 2 (1 draft proposal + the model's own next token); "
+            "use an empty dict to disable"
+        )
+    if hidden < 1:
+        raise ValueError(
+            f"serving.speculative.draft_hidden = {hidden} must be >= 1"
+        )
+    return SpecConfig(draft_k=k, draft_hidden=hidden, draft_params=path)
+
+
+# ------------------------------------------------------------ draft init
+
+def _host_f32(p: Dict[str, Any], name: str) -> np.ndarray:
+    """A full-model leaf as host float32 — dequantized first when the
+    tree is the int8w serving tree (draft init must see real weights)."""
+    leaf = p[name]
+    axis = quant.quant_axis(name)
+    if axis is not None and jnp.dtype(
+        getattr(leaf, "dtype", np.float32)
+    ) == jnp.int8:
+        leaf = quant.dequantize(leaf, p[name + quant.SCALE_SUFFIX], axis)
+    return np.asarray(jax.device_get(leaf), np.float32)
+
+
+def make_draft_params(params, draft_hidden: int) -> Dict[str, np.ndarray]:
+    """Truncation init of the draft tree from the FULL checkpoint: keep
+    the first ``draft_hidden`` units of the embedding, the layer-0 LSTM
+    (input-slice + hidden-slice rows; the matching per-gate column
+    slices of the fused i|f|g|o kernel, so gate structure — including
+    the forget-bias-1.0 slice — survives), and the vocab head.  Cheap
+    and training-free; acceptance rate is what distillation
+    (cli/distill_draft.py) buys on top."""
+    p = params["params"] if "params" in params else params
+    we = _host_f32(p, "word_embed")             # (V, E)
+    lw = _host_f32(p, "lstm0_w")                # (in_dim + H, 4H)
+    lb = _host_f32(p, "lstm0_b")                # (4H,)
+    gw = _host_f32(p, "logit_w")                # (H, V)
+    gb = _host_f32(p, "logit_b")                # (V,)
+    H = lb.shape[0] // 4
+    E = we.shape[1]
+    in_dim = lw.shape[0] - H
+    d = int(draft_hidden)
+    if not 1 <= d <= min(E, H):
+        raise ValueError(
+            f"serving.speculative.draft_hidden = {d} must lie in "
+            f"[1, min(embed_size={E}, rnn_size={H})] for truncation "
+            "init from the full checkpoint"
+        )
+    rows = np.concatenate([lw[:d], lw[in_dim : in_dim + d]], axis=0)
+    cell_w = np.concatenate(
+        [rows[:, g * H : g * H + d] for g in range(4)], axis=1
+    )
+    cell_b = np.concatenate(
+        [lb[g * H : g * H + d] for g in range(4)], axis=0
+    )
+    return {
+        "draft_embed": np.ascontiguousarray(we[:, :d]),
+        "draft_cell_w": cell_w,                 # (2d, 4d), gates i|f|g|o
+        "draft_cell_b": cell_b,                 # (4d,)
+        "draft_head_w": np.ascontiguousarray(gw[:d]),   # (d, V)
+        "draft_head_b": gb,                     # (V,)
+    }
+
+
+def save_draft_params(path: str, dp: Dict[str, Any]) -> None:
+    """Persist a draft tree (cli/distill_draft.py's output format — the
+    file ``serving.speculative.draft_params`` points at)."""
+    np.savez(
+        path,
+        **{k: np.asarray(jax.device_get(dp[k]), np.float32)
+           for k in DRAFT_LEAVES},
+    )
+
+
+def load_draft_params(path: str) -> Dict[str, np.ndarray]:
+    """Load a distilled draft tree; key set is validated so a stale or
+    foreign .npz fails loudly at boot, not as a shape error mid-trace."""
+    with np.load(path) as z:
+        missing = set(DRAFT_LEAVES) - set(z.files)
+        if missing:
+            raise ValueError(
+                f"draft params {path!r} missing leaves {sorted(missing)}"
+            )
+        return {k: np.asarray(z[k], np.float32) for k in DRAFT_LEAVES}
+
+
+# ---------------------------------------------------------- draft step
+
+def draft_logits(
+    draft_params, carry, tok, suppress_unk: bool = False
+):
+    """One draft forward step → ``(carry', masked logits)``.  The
+    differentiable core ``draft_step`` argmaxes over and
+    ``cli/distill_draft.py`` trains through (same decode-policy mask in
+    both places, so the distillation target distribution IS the
+    proposal distribution)."""
+    from cst_captioning_tpu.models.captioner import CaptionModel
+
+    emb = draft_params["draft_embed"][tok]
+    h_new, c_new = lstm_step(
+        LSTMWeights(
+            draft_params["draft_cell_w"], draft_params["draft_cell_b"]
+        ),
+        emb,
+        carry[0],
+        carry[1],
+    )
+    logits = jnp.matmul(
+        h_new, draft_params["draft_head_w"],
+        preferred_element_type=jnp.float32,
+    ) + draft_params["draft_head_b"]
+    logits = CaptionModel.mask_decode_logits(logits, suppress_unk)
+    return jnp.stack([h_new, c_new]), logits
+
+
+def draft_step(
+    draft_params, carry, tok, suppress_unk: bool = False
+):
+    """One greedy draft step.  ``carry``: (2, G, Hd) float32 (h row 0,
+    c row 1 — one stacked leaf keeps the slot matrix's draft column a
+    single array); ``tok``: (G,) int32.  Returns ``(carry', proposal)``.
+    All-float32 compute: the draft's job is agreeing with the full
+    model's argmax, so it gets no low-precision fast path; its entire
+    cost is already ~(Hd/H)^2 of a full step.  The proposal policy
+    masks PAD/BOS (and UNK when the model does) exactly like the full
+    decode policy — proposing a token the verifier can never emit would
+    only burn acceptance."""
+    carry_new, logits = draft_logits(draft_params, carry, tok, suppress_unk)
+    prop = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return carry_new, prop
+
+
+# ------------------------------------------------------------ the round
+
+def spec_round(
+    verify_fn: Callable,
+    draft_fn: Callable,
+    st: CoreState,
+    carry,
+    k: int,
+    *,
+    pick_fn: Optional[Callable] = None,
+) -> Tuple[CoreState, Any, jax.Array]:
+    """One speculative greedy round over ``CoreState`` ``st`` (K == 1
+    slot rows, like ``decode_step``'s greedy mode).
+
+    ``draft_fn(carry, tok) -> (carry', proposal)`` is one draft step;
+    ``verify_fn(state, tokens_k) -> (h_all, c_all, logits)`` is the
+    full model's ``decode_verify`` (plus any sharding constraint), with
+    ``tokens_k`` (k, G) and flat ``logits`` (k*G, V); ``pick_fn`` is
+    the TP cross-shard row pick (``make_tp_row_pick``) or None for the
+    replicated log-softmax argmax — both EXACTLY the decision rule
+    ``decode_step`` applies, which is what makes acceptance exact.
+
+    Returns ``(st', carry', stats)`` where ``stats`` is a (2,) float32
+    ``[tokens emitted this round, live rows this round]`` — the
+    acceptance-rate numerator/denominator the slot decoder accumulates
+    without a host sync.
+
+    Exactness argument (docs/PARITY.md r18): row j of the verify batch
+    computes the model's argmax after consuming ``[tok0, p_0..p_{j-1}]``.
+    Accepting while ``p_j == m_j`` means every consumed proposal WAS the
+    model's own argmax, i.e. the non-speculative loop would have fed the
+    identical prefix — so each emitted ``m_j`` is the token it would
+    have emitted.  The first disagreeing position still emits the
+    MODEL's token (never the draft's), EOS truncates the accepted
+    prefix exactly where the non-speculative loop would have stopped
+    (positions after an accepted EOS stay PAD), and rows that are
+    finished or out of length emit nothing.
+    """
+    G = st.tokens.shape[0]
+    L = st.seqs.shape[-1]
+    # ---- draft: k proposals, one carry snapshot per consumed input.
+    # snaps[j] = carry after consuming [tok0, p_0..p_{j-1}] — the state
+    # to resume from when j+1 tokens get accepted.
+    props, snaps = [], []
+    tok = st.tokens
+    c = carry
+    for _ in range(k):
+        c, tok = draft_fn(c, tok)
+        snaps.append(c)
+        props.append(tok)
+    p = jnp.stack(props, axis=1)                       # (G, k)
+    snap = jnp.stack(snaps, axis=0)                    # (k, 2, G, Hd)
+    # ---- verify: current token then the first k-1 proposals.
+    vin = jnp.concatenate(
+        [st.tokens[None, :], p[:, : k - 1].T], axis=0
+    )                                                  # (k, G)
+    h_all, c_all, logits = verify_fn(st.state, vin)
+    if pick_fn is not None:
+        nxt, tok_lp = pick_fn(logits)
+    else:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nxt = jnp.argmax(logp, axis=-1)
+        tok_lp = jnp.take_along_axis(logp, nxt[:, None], axis=-1)[:, 0]
+    m = nxt.astype(jnp.int32).reshape(k, G).T          # (G, k)
+    lp = tok_lp.reshape(k, G).T                        # (G, k)
+    # ---- the rejection rule: longest draft/model agreement + the
+    # model's next token, truncated at the model's own EOS and at the
+    # row's remaining length.
+    match = (p[:, : k - 1] == m[:, : k - 1]).astype(jnp.int32)
+    n_acc = jnp.sum(jnp.cumprod(match, axis=1), axis=1)    # [0, k-1]
+    pos = jnp.arange(1, k + 1, dtype=jnp.int32)
+    eos_pos = jnp.min(
+        jnp.where(m == EOS_ID, pos[None, :], k + 1), axis=1
+    )                                                  # [1, k+1]
+    finished0 = st.finished[:, 0]
+    valid = (~finished0) & (st.step < L)
+    room = jnp.maximum(L - st.step, 1)
+    n_emit = jnp.minimum(jnp.minimum(n_acc + 1, eos_pos), room)
+    n_emit = jnp.where(valid, n_emit, 0)               # (G,) in [0, k]
+    eos_hit = valid & (eos_pos <= n_emit)
+    finished = st.finished | eos_hit[:, None]
+    step = jnp.minimum(st.step + n_emit, L)
+    idx = jnp.clip(n_emit - 1, 0, k - 1)               # (G,)
+    last_tok = jnp.take_along_axis(m, idx[:, None], axis=1)[:, 0]
+    tokens = jnp.where(valid, last_tok, st.tokens)
+    # ---- scatter the accepted prefix into seqs (and lps) rows.
+    write_pos = st.step[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :]
+    emit = (jnp.arange(k)[None, :] < n_emit[:, None]) & (write_pos < L)
+    onehot = (
+        write_pos[:, :, None]
+        == jnp.arange(L, dtype=jnp.int32)[None, None, :]
+    ) & emit[:, :, None]                               # (G, k, L)
+    written = jnp.any(onehot, axis=1)                  # (G, L)
+    upd = jnp.sum(jnp.where(onehot, m[:, :, None], 0), axis=1)
+    seqs = jnp.where(written, upd, st.seqs[:, 0, :])[:, None, :]
+    lps = st.lps
+    if lps is not None:
+        lp_upd = jnp.sum(jnp.where(onehot, lp[:, :, None], 0.0), axis=1)
+        lps = jnp.where(written, lp_upd, st.lps[:, 0, :])[:, None, :]
+    # ---- resume state: the snapshot after the last ACCEPTED input
+    # (frozen rows select snapshot 0 — harmless drift: their emissions
+    # are suppressed for good and admission resets every leaf).
+    sel = idx[None, None, :, None]
+    state = DecodeState(
+        h=jnp.take_along_axis(h_all, sel, axis=0)[0],
+        c=jnp.take_along_axis(c_all, sel, axis=0)[0],
+    )
+    carry_new = jnp.take_along_axis(snap, sel, axis=0)[0]
+    stats = jnp.stack([
+        jnp.sum(n_emit.astype(jnp.float32)),
+        jnp.sum(valid.astype(jnp.float32)),
+    ])
+    new_st = st._replace(
+        state=state,
+        seqs=seqs,
+        lps=lps,
+        finished=finished,
+        tokens=tokens,
+        step=step,
+    )
+    return new_st, carry_new, stats
+
+
+# --------------------------------------------------- offline backend
+
+def _greedy_spec_runner(ctx) -> Dict[str, np.ndarray]:
+    """``greedy_spec_offline``: the speculative round driven to
+    completion on the harness's fixed batch — must match
+    ``scan_greedy`` token-for-token (and log-prob-for-log-prob)."""
+    model = ctx.make_model()
+    B = ctx.feats[next(iter(ctx.feats))].shape[0]
+    k = 3
+    hidden = 8
+    dp = make_draft_params(ctx.params, hidden)
+    state, cache = model.apply(
+        ctx.params, ctx.feats, ctx.masks, ctx.category,
+        method="init_decode",
+    )
+    st = init_core(state, B, 1, ctx.max_len, mode="greedy")
+    suppress = bool(model.decode_suppress_unk)
+
+    @jax.jit
+    def round_fn(params, dparams, cache, st, carry):
+        def verify_fn(state, vin):
+            return model.apply(
+                params, state, cache, vin, method="decode_verify"
+            )
+
+        def draft_fn(c, tok):
+            return draft_step(dparams, c, tok, suppress)
+
+        return spec_round(verify_fn, draft_fn, st, carry, k)
+
+    carry = jnp.zeros((2, B, hidden), jnp.float32)
+    # Every round advances each live row by >= 1 token, so max_len
+    # rounds always drain the batch.
+    for _ in range(ctx.max_len):
+        st, carry, _ = round_fn(ctx.params, dp, cache, st, carry)
+        fin = np.asarray(jax.device_get(st.finished))[:, 0]
+        stp = np.asarray(jax.device_get(st.step))
+        if bool(np.all(fin | (stp >= ctx.max_len))):
+            break
+    return {
+        "tokens": np.asarray(jax.device_get(st.seqs))[:, 0, :],
+        "lps": np.asarray(jax.device_get(st.lps))[:, 0, :],
+    }
+
+
+register_backend(
+    "greedy_spec_offline", _greedy_spec_runner, kind="greedy",
+    ref="scan_greedy",
+)

@@ -714,6 +714,35 @@ class CaptionModel(nn.Module):
             self._logits(h_top), self.decode_suppress_unk
         )
 
+    def decode_verify(
+        self, state: DecodeState, cache: DecodeCache, tokens_k: jax.Array
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+        """``k`` chained decode steps with ONE batched vocab projection —
+        the verify pass of speculative decode (decoding/speculative.py).
+
+        ``tokens_k`` is (k, B) int32: row 0 each row's current token,
+        rows 1.. the draft's proposals.  Returns ``(h_all, c_all,
+        logits)`` where ``h_all``/``c_all`` are (k, layers, B, H) state
+        snapshots AFTER consuming ``tokens_k[:j+1]`` and ``logits`` row
+        ``j*B + b`` is batch row ``b``'s masked decode-policy logits
+        after its (j+1)-token prefix.  The k recurrence steps stay
+        sequential (hidden-sized — cheap), but the vocab GEMM, the
+        dominant per-step cost, runs ONCE over the stacked (k*B, H)
+        hiddens.  Logits stay flat 2-D so the TP logits sharding
+        constraint and ``make_tp_row_pick`` compose unchanged
+        (serving/slots.py)."""
+
+        def step(st, tok):
+            st, h_top = self._step(st, cache, tok)
+            return st, (st.h, st.c, h_top)
+
+        _, (hs, cs, tops) = jax.lax.scan(step, state, tokens_k)
+        logits = self.mask_decode_logits(
+            self._logits(tops.reshape((-1,) + tops.shape[2:])),
+            self.decode_suppress_unk,
+        )
+        return hs, cs, logits
+
     def decode_one(
         self, state: DecodeState, cache: DecodeCache, tokens: jax.Array
     ) -> Tuple[DecodeState, jax.Array]:

@@ -68,17 +68,40 @@ def quant_axis(name: str) -> Optional[int]:
     return None
 
 
+# int8w calibration modes (serving.quant_calibration).  "absmax" is
+# the PR-16 scheme; "percentile" sets each channel's scale from the
+# 99.9th percentile of |w| instead of the max, clipping the outlier
+# tail (the existing clip to +-127 does the saturation) in exchange
+# for finer resolution on the bulk of the distribution.
+CALIBRATIONS = ("absmax", "percentile")
+PERCENTILE_Q = 99.9
+
+
 def quantize_per_channel(
-    w, axis: int
+    w, axis: int, calibration: str = "absmax"
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Symmetric per-channel int8 quantization of ``w`` along ``axis``.
 
     Returns ``(q int8, scale float32)`` with ``scale.shape ==
     (w.shape[axis],)``.  An all-zero channel gets scale 1.0 so
-    dequantization is always well-defined."""
+    dequantization is always well-defined.  ``calibration`` picks the
+    per-channel scale statistic: ``"absmax"`` (max|w|/127, round-trip
+    error <= scale/2 everywhere) or ``"percentile"`` (99.9th-percentile
+    |w|/127 — values past the percentile saturate at +-127, everything
+    inside keeps the <= scale/2 bound)."""
+    if calibration not in CALIBRATIONS:
+        raise ValueError(
+            f"unknown quant calibration {calibration!r} — expected one "
+            f"of {CALIBRATIONS}"
+        )
     w = jnp.asarray(w, jnp.float32)
     reduce_axes = tuple(i for i in range(w.ndim) if i != axis)
-    amax = jnp.max(jnp.abs(w), axis=reduce_axes)
+    if calibration == "percentile":
+        amax = jnp.percentile(
+            jnp.abs(w), PERCENTILE_Q, axis=reduce_axes
+        )
+    else:
+        amax = jnp.max(jnp.abs(w), axis=reduce_axes)
     scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
     q = jnp.clip(
         jnp.round(w / _bshape(scale, w.ndim, axis)), -127.0, 127.0
@@ -134,17 +157,20 @@ def _param_dict(params) -> Dict[str, Any]:
     return params["params"] if "params" in params else params
 
 
-def quantize_params(params):
+def quantize_params(params, calibration: str = "absmax"):
     """Quantize every quantizable leaf of a float param tree IN the tree:
     each matched leaf becomes int8 and gains (or overwrites) its
     ``<name>_scale`` sibling.  Runs once, host-side, at engine boot or
-    artifact build — never inside a traced function."""
+    artifact build — never inside a traced function.  ``calibration``
+    (serving.quant_calibration) picks the per-channel scale statistic;
+    the resulting scales travel with the tree, so clones and artifact
+    restores never re-read the knob."""
     p = dict(_param_dict(params))
     for name in sorted(p):
         axis = quant_axis(name)
         if axis is None:
             continue
-        q, scale = quantize_per_channel(p[name], axis)
+        q, scale = quantize_per_channel(p[name], axis, calibration)
         p[name] = q
         p[name + SCALE_SUFFIX] = scale
     if "params" in params:

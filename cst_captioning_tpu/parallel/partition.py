@@ -63,6 +63,13 @@ PARTITION_RULES = (
     (r"logit_w_scale$", ("model",)),         # (V,): columns of logit_w
     (r"lstm\d+_w_scale$", ()),               # (4H,): replicated kernels
     (r"att_w[fh]_scale$", ()),               # (A,): replicated att MLP
+    # Speculative-decode draft tree (decoding/speculative.py): a tiny
+    # (draft_hidden-sized) LSTM + head, replicated on every shard —
+    # its entire job is cheap local proposals; the verify step's vocab
+    # GEMM is the sharded one.  The "draft_" prefix keeps these names
+    # out of every full-model regex's reach (all are `$`-anchored on
+    # suffixes the draft names don't share), preserving CST-SHD-001.
+    (r"draft_(embed|cell_[wb]|head_[wb])$", ()),
 )
 
 # Canonical param-leaf names across every model configuration
@@ -95,6 +102,14 @@ KNOWN_PARAM_LEAVES = (
     "lstm1_w_scale",
     "att_wf_scale",
     "att_wh_scale",
+    # Speculative-decode draft tree (decoding/speculative.py::
+    # make_draft_params; tests/test_partition.py walks a real draft
+    # tree so these can't go stale).
+    "draft_embed",
+    "draft_cell_w",
+    "draft_cell_b",
+    "draft_head_w",
+    "draft_head_b",
 )
 
 

@@ -47,6 +47,11 @@ from cst_captioning_tpu.decoding.beam import (
     fused_beam_engaged,
     make_beam_search_fn,
 )
+from cst_captioning_tpu.decoding.speculative import (
+    load_draft_params,
+    make_draft_params,
+    spec_config,
+)
 from cst_captioning_tpu.models.captioner import (
     CaptionModel,
     DecodeCache,
@@ -189,11 +194,17 @@ class InferenceEngine:
             from cst_captioning_tpu.ops import quant
 
             # Quantize ONCE at boot (per-channel scales from the float
-            # weights) unless the tree already carries int8 codes — an
-            # AOT artifact restore or a clone of a quantized engine, for
-            # which re-quantizing would be lossy double rounding.
+            # weights, calibrated per serving.quant_calibration) unless
+            # the tree already carries int8 codes — an AOT artifact
+            # restore or a clone of a quantized engine, for which
+            # re-quantizing would be lossy double rounding (and for
+            # which the original calibration already chose the scales).
             if not quant.is_quantized(params):
-                params = quant.quantize_params(params)
+                params = quant.quantize_params(
+                    params,
+                    str(getattr(sv, "quant_calibration", "absmax")
+                        or "absmax"),
+                )
         if self.tp_mesh is not None:
             from cst_captioning_tpu.parallel import shard_params
 
@@ -205,6 +216,28 @@ class InferenceEngine:
         self.decode_mode = sv.decode_mode
         if self.decode_mode not in ("beam", "greedy"):
             raise ValueError(f"unknown decode_mode {self.decode_mode!r}")
+        # Speculative decode (serving.speculative; decoding/
+        # speculative.py): the draft tree is DERIVED from the serving
+        # params at boot — truncation init, or the distilled .npz the
+        # draft_params knob names — so clones and artifact boots
+        # rebuild the identical draft from the identical weights and
+        # never ship extra state.  The draft only steers proposal
+        # quality; decoded tokens are pinned to the full model by the
+        # rejection rule, so it is NOT part of params_tag.
+        self.draft_params = None
+        spec = spec_config(sv)
+        if spec is not None:
+            if self.decode_mode != "greedy":
+                raise ValueError(
+                    "serving.speculative requires decode_mode='greedy'"
+                )
+            if spec.draft_params:
+                dp = load_draft_params(spec.draft_params)
+            else:
+                dp = make_draft_params(params, spec.draft_hidden)
+            self.draft_params = {
+                k: jnp.asarray(v, jnp.float32) for k, v in dp.items()
+            }
         self.max_batch = sv.max_batch_size or cfg.data.batch_size
         ladder = sorted(set(sv.batch_shapes or _default_ladder(self.max_batch)))
         if ladder[-1] != self.max_batch:

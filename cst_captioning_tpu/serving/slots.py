@@ -148,6 +148,12 @@ from cst_captioning_tpu.decoding.core import (
     decode_step,
     register_backend,
 )
+from cst_captioning_tpu.decoding.speculative import (
+    draft_step,
+    make_draft_params,
+    spec_config,
+    spec_round,
+)
 from cst_captioning_tpu.models.captioner import DecodeCache
 from cst_captioning_tpu.observability.trace import get_tracer, null_tracer
 
@@ -194,6 +200,11 @@ class SlotState(NamedTuple):
 
     core: CoreState       # seqs/scores/finished/tokens/step + (h, c)
     cache: DecodeCache    # leaves lead with S (dedup) or S*K
+    # Speculative decode only (serving.speculative): the draft LSTM's
+    # (2, S, draft_hidden) f32 carry — h row 0, c row 1, one column per
+    # slot.  None (an empty pytree leaf) on non-speculative decoders,
+    # so their slot-state layout is byte-identical to pre-spec builds.
+    draft: Any = None
 
 
 class SlotDecoder:
@@ -226,6 +237,35 @@ class SlotDecoder:
         self.length_normalize = cfg.eval.length_normalize
         self.model = engine.model
         self.V = self.model.vocab_size
+        # Speculative decode (serving.speculative; decoding/
+        # speculative.py): each tick "round" proposes draft_k tokens
+        # from the draft LSTM and verifies them in one batched full-
+        # model step — 1..draft_k tokens emitted per slot per round,
+        # token-exact vs the non-speculative loop by the rejection
+        # rule.  The draft tree itself lives on the ENGINE
+        # (engine.draft_params, built once at boot) and is passed into
+        # the jitted tick as an ARGUMENT — closure-capturing it would
+        # bake device buffers into AOT-serialized executables.
+        self.spec = spec_config(sv)
+        if self.spec is not None and not self.greedy:
+            raise ValueError(
+                "serving.speculative requires decode_mode='greedy' — "
+                "the rejection rule accepts against the model's argmax "
+                "stream, which beam search does not have"
+            )
+        self.spec_k = self.spec.draft_k if self.spec else 0
+        if self.spec is not None:
+            if getattr(engine, "draft_params", None) is None:
+                raise ValueError(
+                    "serving.speculative is on but the engine carries "
+                    "no draft_params — InferenceEngine builds them at "
+                    "boot; a custom engine surface must too"
+                )
+            # Acceptance accounting: a running (2,) device total of
+            # [emitted tokens, live slot-rounds] accumulated with an
+            # async device add per tick (no host sync, O(1) memory);
+            # spec_stats() fetches it on demand.
+            self._spec_totals = jnp.zeros((2,), jnp.float32)
         # Admissions per tick are capped so the padded admission-encode
         # bucket stays within the engine's compiled shape discipline.
         self.admit_cap = min(self.S_max, engine.max_batch)
@@ -342,7 +382,11 @@ class SlotDecoder:
             step=jnp.full((S,), L, jnp.int32),
             rng=None,
         )
-        st = SlotState(core=core, cache=cache)
+        draft = (
+            None if self.spec is None
+            else jnp.zeros((2, S, self.spec.draft_hidden), jnp.float32)
+        )
+        st = SlotState(core=core, cache=cache, draft=draft)
         # Replica engines pin their slot matrix to their device so the
         # first tick doesn't silently run on the default device.
         dev = getattr(self.engine, "device", None)
@@ -386,7 +430,11 @@ class SlotDecoder:
         cache = jax.tree.map(
             lambda x: rows_sharding(mesh, x.shape, 0), st.cache
         )
-        return SlotState(core=core, cache=cache)
+        draft = (
+            None if st.draft is None
+            else rows_sharding(mesh, st.draft.shape, 1)
+        )
+        return SlotState(core=core, cache=cache, draft=draft)
 
     def _build_step(self) -> None:
         model, K, dedup = self.model, self.K, self.dedup
@@ -462,9 +510,47 @@ class SlotDecoder:
                 step_logits, st.core, mode=mode,
                 topk_fn=tp_topk, pick_fn=tp_pick,
             )
-            return SlotState(core=core, cache=st.cache)
+            return SlotState(core=core, cache=st.cache, draft=st.draft)
 
         self._step_once = step_once
+
+        # Speculative round (decoding/speculative.py::spec_round): the
+        # verify closure is step_once's twin — the model's batched
+        # k-step verify plus the SAME TP logits constraint and the SAME
+        # cross-shard row pick, which is what keeps TP speculative
+        # decode token-exact through the one pick definition.  Greedy
+        # implies K == 1, so the dedup row->slot gather degenerates to
+        # the identity and the stored cache feeds the verify directly.
+        if self.spec is not None:
+            spec_k = self.spec.draft_k
+            suppress = bool(getattr(model, "decode_suppress_unk", False))
+
+            def spec_once(params, dparams, st: SlotState):
+                def verify_fn(state, vin):
+                    h_all, c_all, logits = model.apply(
+                        params, state, st.cache, vin,
+                        method="decode_verify",
+                    )
+                    if tp_logits is not None:
+                        logits = jax.lax.with_sharding_constraint(
+                            logits, tp_logits
+                        )
+                    return h_all, c_all, logits
+
+                def draft_fn(c, tok):
+                    return draft_step(dparams, c, tok, suppress)
+
+                core, draft, stats = spec_round(
+                    verify_fn, draft_fn, st.core, st.draft, spec_k,
+                    pick_fn=tp_pick,
+                )
+                return (
+                    SlotState(core=core, cache=st.cache, draft=draft),
+                    stats,
+                )
+
+            self._spec_once = spec_once
+
         self._scores0 = jnp.where(
             jnp.arange(K) == 0, 0.0, NEG_INF
         ).astype(jnp.float32)[None, :]                          # (1, K)
@@ -544,30 +630,42 @@ class SlotDecoder:
                     co.step, jnp.zeros((1,), jnp.int32), (slot,)
                 ),
             )
-            return SlotState(core=core, cache=cache)
+            draft = st.draft
+            if draft is not None:
+                # Fresh draft carry for the admitted slot's column.
+                draft = jax.lax.dynamic_update_slice(
+                    draft,
+                    jnp.zeros((2, 1, draft.shape[-1]), jnp.float32),
+                    (jnp.int32(0), slot, jnp.int32(0)),
+                )
+            return SlotState(core=core, cache=cache, draft=draft)
+
+        def admit_all(st: SlotState, slots, rows: DecodeCache):
+            if not dedup:
+                # Legacy replicated layout only: fan each request's
+                # row out to its K beam rows before the scatter.
+                rows = jax.tree.map(
+                    lambda x: jnp.repeat(x, K, axis=0), rows
+                )
+            R = 1 if dedup else K
+            for i in range(A):
+                req_rows = jax.tree.map(
+                    lambda r: jax.lax.dynamic_slice(
+                        r,
+                        (i * R,) + (0,) * (r.ndim - 1),
+                        (R,) + r.shape[1:],
+                    ),
+                    rows,
+                )
+                st = admit_one(
+                    st, slots[i].astype(jnp.int32), req_rows
+                )
+            return st
 
         @jax.jit
         def tick(params, st: SlotState, slots, rows: DecodeCache):
             if A:
-                if not dedup:
-                    # Legacy replicated layout only: fan each request's
-                    # row out to its K beam rows before the scatter.
-                    rows = jax.tree.map(
-                        lambda x: jnp.repeat(x, K, axis=0), rows
-                    )
-                R = 1 if dedup else K
-                for i in range(A):
-                    req_rows = jax.tree.map(
-                        lambda r: jax.lax.dynamic_slice(
-                            r,
-                            (i * R,) + (0,) * (r.ndim - 1),
-                            (R,) + r.shape[1:],
-                        ),
-                        rows,
-                    )
-                    st = admit_one(
-                        st, slots[i].astype(jnp.int32), req_rows
-                    )
+                st = admit_all(st, slots, rows)
             for _ in range(block):
                 st = step_once(params, st)
             done = jnp.all(st.core.finished, axis=-1) | (
@@ -575,8 +673,31 @@ class SlotDecoder:
             )
             return st, done, st.core.seqs, st.core.scores
 
-        self._tick_fns[key] = tick
-        return tick
+        spec_once = getattr(self, "_spec_once", None)
+
+        @jax.jit
+        def tick_spec(
+            params, dparams, st: SlotState, slots, rows: DecodeCache
+        ):
+            # The speculative tick: identical admissions, but each of
+            # the `block` iterations is a propose/verify ROUND emitting
+            # 1..draft_k tokens per live slot; the (2,) stats vector
+            # sums [emitted, live] over the block so the host can
+            # accumulate acceptance accounting without a sync.
+            if A:
+                st = admit_all(st, slots, rows)
+            stats = jnp.zeros((2,), jnp.float32)
+            for _ in range(block):
+                st, s = spec_once(params, dparams, st)
+                stats = stats + s
+            done = jnp.all(st.core.finished, axis=-1) | (
+                st.core.step >= L
+            )
+            return st, done, st.core.seqs, st.core.scores, stats
+
+        fn = tick if self.spec is None else tick_spec
+        self._tick_fns[key] = fn
+        return fn
 
     def _free_fn(self, S: int):
         """Compiled freed-slot blanking: reset the masked slots' cache
@@ -621,7 +742,10 @@ class SlotDecoder:
                 tokens=jnp.where(mask_n, jnp.int32(BOS_ID), co.tokens),
                 step=jnp.where(mask, jnp.int32(L), co.step),
             )
-            return SlotState(core=core, cache=cache)
+            draft = st.draft
+            if draft is not None:
+                draft = jnp.where(mask[None, :, None], 0.0, draft)
+            return SlotState(core=core, cache=cache, draft=draft)
 
         self._free_fns[S] = free_rows
         return free_rows
@@ -675,7 +799,11 @@ class SlotDecoder:
                 tokens=scale(co.tokens, BOS_ID),
                 step=scale(co.step, L),
             )
-            return SlotState(core=core, cache=cache)
+            draft = (
+                None if st.draft is None
+                else scale(st.draft, 0.0, axis=1)
+            )
+            return SlotState(core=core, cache=cache, draft=draft)
 
         self._resize_fns[key] = resize
         return resize
@@ -798,7 +926,8 @@ class SlotDecoder:
           × S stored rows deduped, S·K replicated;
         carry (per slot):  layers·K·H·(cdt+4)   (h compute-dtype, c f32)
                          + K·L·4 (seqs) + K·4 (beam scores)
-                         + K (finished bool) + K·4 (tokens) + 4 (step).
+                         + K (finished bool) + K·4 (tokens) + 4 (step)
+                         + 2·draft_hidden·4 (speculative draft carry).
         """
         S = self.S if S is None else S
         m, d = self.model, self.engine.cfg.data
@@ -817,6 +946,7 @@ class SlotDecoder:
             + K
             + K * 4
             + 4
+            + (0 if self.spec is None else 2 * self.spec.draft_hidden * 4)
         )
         return cache + carry
 
@@ -870,9 +1000,18 @@ class SlotDecoder:
         for s, d in zip(slots, datas):
             self.occupied[s] = d
             self.admit_tick[s] = self._seq
-        self._st, done, seqs_d, scores_d = self._tick_fn(A)(
-            self.engine.params, self._st, slot_arr, rows
-        )
+        if self.spec is not None:
+            self._st, done, seqs_d, scores_d, stats = self._tick_fn(A)(
+                self.engine.params, self.engine.draft_params,
+                self._st, slot_arr, rows,
+            )
+            # Async device add: the totals stay a lazy device value,
+            # never forcing a sync on the dispatch path.
+            self._spec_totals = self._spec_totals + stats
+        else:
+            self._st, done, seqs_d, scores_d = self._tick_fn(A)(
+                self.engine.params, self._st, slot_arr, rows
+            )
         handle = TickHandle(self._seq, done, seqs_d, scores_d)
         self._last_handle = handle
         # Host side of the tick only: the dispatch returns before the
@@ -971,8 +1110,13 @@ class SlotDecoder:
             data = self.occupied.pop(slot)
             # Device steps the caption paid: every dispatched tick from
             # its admission tick through the handle's tick ran `block`
-            # steps over its rows.
-            paid = (handle.seq - self.admit_tick.pop(slot) + 1) * self.block
+            # steps over its rows.  Speculative rounds emit up to
+            # draft_k tokens each, so the per-caption charge scales by
+            # k (an upper bound — min(·, L) below keeps it honest).
+            paid = (
+                (handle.seq - self.admit_tick.pop(slot) + 1)
+                * self.block * max(1, self.spec_k)
+            )
             bisect.insort(self.free, slot)
             out.append((
                 data,
@@ -1032,9 +1176,15 @@ class SlotDecoder:
             # template caption finishes within one block: compile it
             # explicitly.  Empty slots are frozen, so stepping them is
             # a no-op on every harvested number.
-            self._st, *_ = self._tick_fn(0)(
-                self.engine.params, self._st, None, None
-            )
+            if self.spec is not None:
+                self._st, *_ = self._tick_fn(0)(
+                    self.engine.params, self.engine.draft_params,
+                    self._st, None, None,
+                )
+            else:
+                self._st, *_ = self._tick_fn(0)(
+                    self.engine.params, self._st, None, None
+                )
             if self.zero_freed:
                 self._free_fn(bank)(
                     self._st, jnp.zeros((bank,), bool)
@@ -1045,6 +1195,9 @@ class SlotDecoder:
             self._set_bank(bank)
         self.resize_count = 0
         self.last_resize_ms = self.worst_resize_ms = 0.0
+        if self.spec is not None:
+            # Warmup traffic must not count toward served acceptance.
+            self._spec_totals = jnp.zeros((2,), jnp.float32)
         assert not self.occupied and len(self.free) == self.S
 
     # ----------------------------------------------- AOT artifact ladder
@@ -1069,10 +1222,15 @@ class SlotDecoder:
         hit post-warmup: tick fns per (bank, admit bucket), the
         freed-slot blanking fn per bank, and both directions of every
         adjacent bank transition."""
+        # Speculative ticks are a distinct variant family: the traced
+        # program embeds draft_k, so the key carries it — an artifact
+        # built without speculation (or at another k) fails the
+        # loader's key-set equality check instead of mis-installing.
+        spec_sfx = f":k{self.spec_k}" if self.spec is not None else ""
         keys: List[str] = []
         for bank in self.bank_ladder:
             for A in self.warm_admit_counts(bank):
-                keys.append(f"tick:S{bank}:A{A}")
+                keys.append(f"tick:S{bank}:A{A}{spec_sfx}")
             if self.zero_freed:
                 keys.append(f"free:S{bank}")
         for a, b in zip(self.bank_ladder, self.bank_ladder[1:]):
@@ -1102,6 +1260,14 @@ class SlotDecoder:
         p_avals = jax.tree.map(
             lambda x: sds(x.shape, x.dtype), self.engine.params
         )
+        dp_avals = (
+            None if self.spec is None
+            else jax.tree.map(
+                lambda x: sds(jnp.shape(x), jnp.asarray(x).dtype),
+                dict(self.engine.draft_params),
+            )
+        )
+        spec_sfx = f":k{self.spec_k}" if self.spec is not None else ""
         out = []
         for bank in self.bank_ladder:
             st_avals = self._state_avals(bank)
@@ -1112,10 +1278,15 @@ class SlotDecoder:
                     # legacy replicated tick fans out to K inside.
                     rows = self._cache_avals(A)
                     slots = sds((A,), jnp.int32)
-                    low = fn.lower(p_avals, st_avals, slots, rows)
                 else:
-                    low = fn.lower(p_avals, st_avals, None, None)
-                out.append((f"tick:S{bank}:A{A}", low))
+                    rows = slots = None
+                if self.spec is not None:
+                    low = fn.lower(
+                        p_avals, dp_avals, st_avals, slots, rows
+                    )
+                else:
+                    low = fn.lower(p_avals, st_avals, slots, rows)
+                out.append((f"tick:S{bank}:A{A}{spec_sfx}", low))
             if self.zero_freed:
                 mask = sds((bank,), jnp.bool_)
                 out.append((
@@ -1160,8 +1331,19 @@ class SlotDecoder:
         for key, fn in executables.items():
             kind, _, rest = key.partition(":")
             if kind == "tick":
-                s_part, _, a_part = rest.partition(":")
-                self._tick_fns[(int(s_part[1:]), int(a_part[1:]))] = fn
+                parts = rest.split(":")           # S..:A..[:k..]
+                if len(parts) == 3 and int(parts[2][1:]) != self.spec_k:
+                    raise ValueError(
+                        f"AOT tick variant {key!r} was built at "
+                        f"draft_k={parts[2][1:]} but this decoder runs "
+                        f"draft_k={self.spec_k}"
+                    )
+                if len(parts) == 2 and self.spec is not None:
+                    raise ValueError(
+                        f"AOT tick variant {key!r} was built without "
+                        "speculation but serving.speculative is on"
+                    )
+                self._tick_fns[(int(parts[0][1:]), int(parts[1][1:]))] = fn
             elif kind == "free":
                 self._free_fns[int(rest[1:])] = fn
             elif kind == "resize":
@@ -1169,6 +1351,30 @@ class SlotDecoder:
                 self._resize_fns[(int(a), int(b))] = fn
             else:
                 raise ValueError(f"unknown AOT variant key {key!r}")
+
+    def spec_stats(self) -> Dict[str, float]:
+        """Speculation accounting since warmup (one device fetch of the
+        running (2,) total): emitted tokens, live slot-rounds, the
+        draft acceptance rate ((emitted - rounds) / (rounds * (k - 1))
+        — the fraction of offered draft tokens the verifier accepted),
+        and mean tokens emitted per live slot-round (the speedup
+        headline: 1.0 is the non-speculative floor, k the ceiling)."""
+        if self.spec is None:
+            return {}
+        tot = np.asarray(jax.device_get(self._spec_totals))
+        emitted, rounds = float(tot[0]), float(tot[1])
+        k = self.spec_k
+        acc = (
+            min(1.0, max(0.0, (emitted - rounds) / (rounds * (k - 1))))
+            if rounds > 0 and k > 1 else 0.0
+        )
+        return {
+            "draft_k": float(k),
+            "emitted_tokens": emitted,
+            "live_slot_rounds": rounds,
+            "acceptance_rate": acc,
+            "tokens_per_round": emitted / rounds if rounds > 0 else 0.0,
+        }
 
     def describe(self) -> Dict[str, Any]:
         return {
@@ -1194,6 +1400,12 @@ class SlotDecoder:
             "live_state_bytes": self.live_state_bytes(),
             "bytes_per_request": self.per_slot_bytes(),
             "bank_resizes": self.resize_count,
+            "speculative": (
+                {} if self.spec is None else {
+                    "draft_k": self.spec.draft_k,
+                    "draft_hidden": self.spec.draft_hidden,
+                }
+            ),
         }
 
 
@@ -1209,7 +1421,7 @@ class _ParityEngine:
     def __init__(
         self, ctx, *, mode: str, num_slots: int, block: int,
         dedup: bool = True, bank_min: int = 0, model_shards: int = 1,
-        shard_fused: bool = True,
+        shard_fused: bool = True, speculative: Optional[dict] = None,
     ):
         from types import SimpleNamespace
 
@@ -1243,6 +1455,13 @@ class _ParityEngine:
         self._feats, self._masks, self._cat = (
             ctx.feats, ctx.masks, ctx.category,
         )
+        # Draft tree from the SAME params the slot loop decodes with —
+        # built after any TP sharding, exactly like the real engine.
+        self.draft_params = (
+            make_draft_params(
+                self.params, int(speculative["draft_hidden"])
+            ) if speculative else None
+        )
         d0 = next(iter(ctx.feats.values()))
         self.cfg = SimpleNamespace(
             serving=SimpleNamespace(
@@ -1250,6 +1469,7 @@ class _ParityEngine:
                 dedup_cache=dedup, slot_bank_min=bank_min,
                 slot_shrink_idle_ticks=4, zero_freed_slots=True,
                 shard_fused_decode=shard_fused,
+                speculative=dict(speculative or {}),
             ),
             eval=SimpleNamespace(
                 beam_size=ctx.beam_size, max_decode_len=ctx.max_len,
@@ -1280,7 +1500,8 @@ class _ParityEngine:
 
 def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0,
                  model_shards: int = 1, aot: bool = False,
-                 shard_fused: bool = True):
+                 shard_fused: bool = True,
+                 speculative: Optional[dict] = None):
     """Decode every ctx row through a small slot matrix with staggered
     admissions (slots hold rows at different decode depths), then map
     harvests back to row order.  ``dedup`` selects the per-slot vs the
@@ -1296,7 +1517,7 @@ def _slot_runner(ctx, mode: str, dedup: bool = True, bank_min: int = 0,
     eng = _ParityEngine(
         ctx, mode=mode, num_slots=max(2, B // 2), block=1,
         dedup=dedup, bank_min=bank_min, model_shards=model_shards,
-        shard_fused=shard_fused,
+        shard_fused=shard_fused, speculative=speculative,
     )
     dec = SlotDecoder(eng)
     if aot:
@@ -1414,6 +1635,32 @@ register_backend(
     "slot_decoder_greedy_tp2_fused",
     lambda ctx: _slot_runner(ctx, "greedy", model_shards=2,
                              shard_fused=True),
+    kind="greedy",
+    ref="scan_greedy",
+)
+# Speculative decode on the slot runtime (decoding/speculative.py):
+# draft-LSTM propose, full-model batched verify, standard rejection —
+# the emitted stream must be BIT-IDENTICAL to scan_greedy even though
+# slots advance 1..draft_k tokens per tick at staggered depths
+# (docs/PARITY.md r18).
+register_backend(
+    "slot_decoder_greedy_spec",
+    lambda ctx: _slot_runner(
+        ctx, "greedy", speculative={"draft_k": 3, "draft_hidden": 8},
+    ),
+    kind="greedy",
+    ref="scan_greedy",
+)
+# Artifact boot WITH speculation (the ISSUE-18 acceptance pin): the
+# :k-suffixed tick variants are lowered/compiled by a builder decoder
+# and installed into a fresh one that must trace nothing itself —
+# compile_count stays 0 AND the spec stream stays exact.
+register_backend(
+    "slot_decoder_greedy_spec_aot",
+    lambda ctx: _slot_runner(
+        ctx, "greedy", aot=True,
+        speculative={"draft_k": 3, "draft_hidden": 8},
+    ),
     kind="greedy",
     ref="scan_greedy",
 )

@@ -332,6 +332,59 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="lowprec_int8w_tp2"):
             validate_record(rec)
 
+    def test_spec_row_passes(self):
+        """A well-formed speculative-decode row (ISSUE 18): every
+        spec_* field numeric by contract, acceptance fractions in the
+        unit interval, provenance strings exempted by suffix."""
+        rec = good_bench()
+        rec["extra"].update({
+            "spec_mesh_shape": "1x1",
+            "spec_xla_flags": "",
+            "spec_jax_platforms": "cpu",
+            "spec_host_cores": 1.0,
+            "spec_draft_k": 4,
+            "spec_draft_hidden": 16,
+            "spec_token_mismatches": 0,
+            "spec_acceptance_rate": 0.62,
+            "spec_tokens_per_tick": 2.1,
+            "spec_tokens_per_round": 2.86,
+            "spec_captions_per_sec": 1810.4,
+            "spec_baseline_captions_per_sec": 1502.7,
+            "spec_p99_tick_ms": 3.9,
+            "spec_distill_steps": 60,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "exact", [0]])
+    def test_non_numeric_spec_field_fails(self, bad):
+        """spec_token_mismatches is THE token-exactness gate count —
+        a bool True (== 1 under int arithmetic) or prose must fail the
+        emit, not masquerade as a measurement."""
+        rec = good_bench()
+        rec["extra"]["spec_token_mismatches"] = bad
+        with pytest.raises(ValueError, match="spec_token_mismatches"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [-0.01, 1.01, 62.0])
+    def test_spec_acceptance_outside_unit_interval_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["spec_acceptance_rate"] = bad
+        with pytest.raises(ValueError, match="acceptance"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, False])
+    def test_bool_spec_acceptance_fails(self, bad):
+        rec = good_bench()
+        rec["extra"]["spec_acceptance_rate"] = bad
+        with pytest.raises(ValueError, match="spec_acceptance_rate"):
+            validate_record(rec)
+
+    def test_spec_mesh_shape_still_topology_checked(self):
+        rec = good_bench()
+        rec["extra"]["spec_mesh_shape"] = "one"
+        with pytest.raises(ValueError, match="mesh"):
+            validate_record(rec)
+
     def test_lowprec_mesh_shape_still_topology_checked(self):
         rec = good_bench()
         rec["extra"]["lowprec_mesh_shape"] = "one-by-two"

@@ -109,6 +109,11 @@ class TestSharedParity:
             "fused_beam_tp2", "fused_sampler_tp2",
             "slot_decoder_beam_tp2", "slot_decoder_beam_tp2_fused",
             "slot_decoder_greedy_tp2_fused",
+            # ISSUE 18: speculative decode — the offline propose/verify
+            # round and the slot-runtime spec tick, both pinned
+            # token-exact against scan_greedy through this harness.
+            "greedy_spec_offline", "slot_decoder_greedy_spec",
+            "slot_decoder_greedy_spec_aot",
         } <= set(ALL_BACKENDS)
 
     def test_beam1_equals_greedy(self, ctx):
@@ -207,6 +212,46 @@ class TestSlotRolloutInvariance:
         assert sorted(seen) == list(range(n))
         assert stats["rollout_rows"] == n
         assert 0 < stats["rollout_steps_per_row"] <= ctx.max_len
+
+
+class TestSpeculativeSlotFuzz:
+    """ISSUE 18: the speculative stream's token-exactness must survive
+    ANY arrival order — fuzzed admission counts leave slots at arbitrary
+    staggered depths, so each spec round mixes rows with different
+    remaining lengths and EOS proximity (exactly where a sloppy
+    accept/truncate rule would drift from the scan reference)."""
+
+    @pytest.mark.parametrize("seed", [7, 19, 123])
+    def test_fuzzed_arrival_orders_stay_exact(self, ctx, seed):
+        from cst_captioning_tpu.serving.slots import (
+            SlotDecoder,
+            _ParityEngine,
+        )
+
+        ref = core.get_backend("scan_greedy").run(ctx)
+        rng = np.random.RandomState(seed)
+        eng = _ParityEngine(
+            ctx, mode="greedy", num_slots=3, block=1,
+            speculative={"draft_k": 3, "draft_hidden": 8},
+        )
+        dec = SlotDecoder(eng)
+        got = {}
+        pending = list(range(B))
+        while pending or dec.occupied:
+            cap = min(len(pending), len(dec.free), dec.admit_cap)
+            n = int(rng.randint(0, cap + 1)) if cap else 0
+            if n == 0 and not dec.occupied:
+                n = min(1, cap)               # never stall an empty bank
+            adm = [pending.pop(0) for _ in range(n)]
+            done = dec.tick(adm, adm)
+            for i, tokens, _score, steps in dec.harvest_many(done):
+                got[i] = tokens
+                assert 0 < steps <= dec.L
+        toks = np.stack([got[i] for i in range(B)])
+        np.testing.assert_array_equal(
+            toks, ref["tokens"],
+            err_msg=f"spec slot tokens diverged under arrival seed {seed}",
+        )
 
 
 # ---------------------------------------------- single-definition guard

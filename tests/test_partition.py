@@ -93,6 +93,16 @@ class TestPartitionRules:
                     "drifted from the model"
                 )
                 seen.add(leaf)
+        # Speculative-decode draft tree (ISSUE 18): a REAL tree the
+        # serving engine ships, so its leaves ride the same
+        # no-rot-in-either-direction contract as the model trees.
+        from cst_captioning_tpu.decoding.speculative import make_draft_params
+
+        for leaf in make_draft_params(params, draft_hidden=4):
+            assert leaf in partition.KNOWN_PARAM_LEAVES, (
+                f"draft leaf {leaf!r} missing from KNOWN_PARAM_LEAVES"
+            )
+            seen.add(leaf)
         missing = set(partition.KNOWN_PARAM_LEAVES) - seen
         assert not missing, (
             f"KNOWN_PARAM_LEAVES entries {sorted(missing)} exist in no "

@@ -147,6 +147,57 @@ class TestQuantPrimitives:
         # the headline ratio: int8 weight payload is exactly 0.25x f32
         assert wbytes * 4 == 64 * 256 * 4
 
+    def test_unknown_calibration_is_a_named_error(self):
+        with pytest.raises(ValueError, match="calibration"):
+            quant.quantize_per_channel(
+                jnp.ones((4, 4), jnp.float32), 0, "minmax"
+            )
+
+    def test_percentile_calibration_clips_the_outlier_tail(self):
+        """serving.quant_calibration="percentile": the per-channel scale
+        comes from the 99.9th percentile of |w|, so a planted outlier
+        tail saturates at +-127 while everything inside the percentile
+        keeps the <= scale/2 round-trip bound with a FINER step than
+        absmax would have chosen."""
+        rng = np.random.RandomState(4)
+        w = rng.randn(2000, 4).astype(np.float32)
+        w[:2, :] = 50.0                   # 2 outliers per column channel
+        w = jnp.asarray(w)
+        qa, sa = quant.quantize_per_channel(w, 1, "absmax")
+        qp, sp = quant.quantize_per_channel(w, 1, "percentile")
+        assert qp.dtype == jnp.int8 and sp.dtype == jnp.float32
+        # percentile scale is strictly finer: the outliers set absmax's
+        # step (50/127) but sit past the 99.9th percentile here
+        assert bool(jnp.all(sp < sa))
+        assert bool(jnp.all(sp * 127.0 < 50.0))
+        assert bool(jnp.all(jnp.abs(qp[:2]) == 127))   # tail saturates
+        dq = quant.dequantize(qp, sp, 1)
+        inside = jnp.abs(w) <= sp[None, :] * 127.0
+        err = jnp.where(inside, jnp.abs(w - dq), 0.0)
+        assert bool(jnp.all(err <= sp[None, :] / 2 + 1e-6))
+        # absmax is still the documented default — positionally stable
+        q_dflt, s_dflt = quant.quantize_per_channel(w, 1)
+        assert bool(jnp.all(s_dflt == sa))
+        assert bool(jnp.all(q_dflt == qa))
+
+    def test_quantize_params_plumbs_calibration(self):
+        rng = np.random.RandomState(5)
+        emb = rng.randn(8, 2000).astype(np.float32)
+        emb[:, :2] = 30.0                 # per-row outlier pair
+        tree = {"params": {
+            "word_embed": jnp.asarray(emb),
+            "logit_b": jnp.zeros((8,), jnp.float32),
+        }}
+        qa = quant.quantize_params(tree)["params"]
+        qp = quant.quantize_params(tree, "percentile")["params"]
+        assert bool(jnp.all(qp["word_embed_scale"]
+                            < qa["word_embed_scale"]))
+        # scale_hashes (the artifact integrity record) see the choice
+        assert (quant.scale_hashes({"params": qa})
+                != quant.scale_hashes({"params": qp}))
+        with pytest.raises(ValueError, match="calibration"):
+            quant.quantize_params(tree, "median")
+
 
 # ---------------------------------------------------------- scale specs
 
@@ -331,6 +382,76 @@ class TestServingDtypeEngines:
             f"{dtype}: max per-caption score gap {gap.max():.4f} above "
             f"the pinned rtol {RELAXED_SERVING_SCORE_RTOL}"
         )
+
+    def test_unknown_calibration_knob_refused_at_boot(self):
+        cfg = _tiny_cfg("int8w")
+        cfg.serving.quant_calibration = "median"
+        with pytest.raises(ValueError, match="calibration"):
+            InferenceEngine(cfg, random_init=True)
+
+    def test_percentile_calibration_holds_relaxed_bounds(self, dtype_world):
+        """serving.quant_calibration="percentile" on the SAME float
+        weights: the scales actually move (different hashes than
+        absmax), and the engine still holds the relaxed-serving parity
+        contract vs f32 — the calibration knob trades step size, never
+        the machine-checked bound."""
+        f32 = dtype_world["f32"]
+        cfg = _tiny_cfg("int8w")
+        cfg.model.vocab_size = len(f32.vocab)
+        cfg.serving.quant_calibration = "percentile"
+        eng = InferenceEngine(cfg, params=f32.params, vocab=f32.vocab)
+        assert quant.is_quantized(eng.params)
+        assert (quant.scale_hashes(eng.params)
+                != quant.scale_hashes(dtype_world["int8w"].params))
+        payloads = _payloads(f32.cfg, 8)
+        ref = _captions(f32, payloads)
+        got = _captions(eng, payloads)
+        match = sum(a == b for a, b in zip(ref, got)) / len(ref)
+        assert match >= RELAXED_SERVING_MATCH_FLOOR, (
+            f"percentile: caption-match rate {match:.3f} below the "
+            f"pinned floor {RELAXED_SERVING_MATCH_FLOOR}"
+        )
+        s_ref = _beam_scores(f32, payloads)
+        s_low = _beam_scores(eng, payloads)
+        gap = np.abs(s_low - s_ref) / np.maximum(np.abs(s_ref), 1e-6)
+        assert float(gap.max()) <= RELAXED_SERVING_SCORE_RTOL
+
+
+# ------------------------------------------------- autoscale under int8w
+
+class TestInt8wAutoscale:
+    def test_add_replica_boots_from_the_quantized_tree(self, dtype_world):
+        """Scale-up under serving.dtype=int8w (ISSUE 18): the replica
+        admitted by ``ReplicaSet.add_replica`` boots from the ALREADY
+        quantized tree — the ``is_quantized`` boot guard skips
+        requantization, so there is no double rounding: ``params_tag``,
+        every scale hash, and the int8 codes themselves are identical
+        to replica 0's."""
+        from cst_captioning_tpu.serving.metrics import ServingMetrics
+        from cst_captioning_tpu.serving.replicas import ReplicaSet
+
+        e0 = dtype_world["int8w"]
+        dev = jax.devices()[0]
+        r0 = e0.clone_for_device(dev, replica_id=0)
+        rs = ReplicaSet([r0], ServingMetrics())
+        rid = rs.add_replica(e0.clone_for_device(dev))
+        assert rid == 1
+        r1 = rs.replicas[rid].engine
+        assert r1.replica_id == rid        # admission stamps the id
+        assert quant.is_quantized(r1.params)
+        # the tier-1/2 cache-key contract: one logical model fleet-wide
+        assert r1.params_tag == r0.params_tag
+        h0 = quant.scale_hashes(r0.params)
+        assert h0 and quant.scale_hashes(r1.params) == h0
+        p0, p1 = (p["params"] if "params" in p else p
+                  for p in (r0.params, r1.params))
+        for name in p0:
+            if quant.quant_axis(name) is None:
+                continue
+            assert p1[name].dtype == jnp.int8, name
+            assert np.array_equal(
+                np.asarray(p0[name]), np.asarray(p1[name])
+            ), f"{name}: int8 codes moved across add_replica"
 
 
 # ----------------------------------------------------- quantized artifact
