@@ -232,6 +232,31 @@ def validate_record(rec: dict, kind: str = "bench") -> dict:
                     f"{k!r} must be a caption-match fraction in "
                     f"[0, 1], got {v!r}"
                 )
+        # Fused×int8w composition rows (ISSUE 20): lowprec_fused_*
+        # rides the lowprec_* numeric contract above, plus two
+        # closed-form invariants the bench asserts before emit and the
+        # validator re-checks at the schema layer: every *_tile_ratio
+        # is EXACTLY 0.25 (int8 code bytes over the f32 vocab tile —
+        # any other value means the kernels stopped streaming int8
+        # codes or the tile arithmetic drifted), and every *_declines
+        # count is EXACTLY 0 (serving.dtype=int8w must never gate a
+        # requested fused kernel off on a supported grid — the decline
+        # lift IS the tentpole claim, so the schema enforces it).
+        for k, v in rec["extra"].items():
+            if not k.startswith("lowprec_fused_"):
+                continue
+            if k.endswith("_tile_ratio") and v != 0.25:
+                fail(
+                    f"{k!r} must be exactly 0.25 (int8 codes over the "
+                    f"f32 vocab tile), got {v!r}"
+                )
+            if k.endswith("_declines") and (
+                isinstance(v, bool) or v != 0
+            ):
+                fail(
+                    f"{k!r} must be exactly 0 — int8w composes with "
+                    f"the fused kernels by contract, got {v!r}"
+                )
         # Speculative-decode rows (ISSUE 18): every spec_* field is a
         # measurement by contract — numeric, never bool/None/prose
         # (the paired spec/baseline rows are only comparable when both
@@ -3210,6 +3235,352 @@ def bench_lowprec(backend_ok: bool = True):
     return out
 
 
+def _bench_lowprec_fused_impl():
+    """Paired fused-f32 / fused-int8w / unfused-int8w beam-serving rows
+    (the in-process child of :func:`bench_lowprec_fused`; ISSUE 20).
+
+    One random init, one fixed payload set, three engines per grid on
+    the 1-device placement and the (1, 2) tensor-parallel submesh:
+
+    * ``f32_fused``     — ``use_pallas_*`` on, serving.dtype=f32
+    * ``int8w_fused``   — ``use_pallas_*`` on, serving.dtype=int8w:
+      the kernels stream int8 code tiles + per-channel scale rows and
+      dequantize IN-KERNEL (``ops/quant.py::quant_matmul`` semantics —
+      scale after the f32-pinned accumulation)
+    * ``int8w_unfused`` — ``use_pallas_*`` off: the scan/XLA reference
+      the relaxed-serving bounds are pinned against
+
+    THREE gates run before anything records.  (1) Zero int8w-caused
+    declines: ``warn_fused_decline`` lines are counted during
+    build+decode of each fused arm, and the int8w arm must log EXACTLY
+    as many as the f32 arm built identically — quantization itself
+    must never gate a kernel off (the decline lift IS the tentpole).
+    Environmental declines (the CPU-backend interpret gate fires for
+    f32 and int8w alike; the TP=2 shard_map port is pure XLA and
+    engages on any backend) cancel in the comparison, so the recorded
+    ``*_extra_declines`` fields are 0 by contract on every host.
+    (2) Relaxed-serving parity: fused-int8w caption match
+    vs BOTH the fused-f32 arm and the unfused-int8w reference >=
+    RELAXED_SERVING_MATCH_FLOOR, and per-caption beam-score gap vs the
+    unfused-int8w reference <= RELAXED_SERVING_SCORE_RTOL — perf for
+    out-of-contract captions must never ship.  (3) The streamed vocab
+    tile is EXACTLY 0.25x the f32 tile by closed form
+    (``quantized_leaf_bytes``), on the 1-device grid AND per shard on
+    TP=2, cross-checked against the measured engine bytes.
+
+    Off-TPU the single-device kernels run in Pallas interpret mode —
+    the captions/s rows caveat themselves through the recorded
+    ``*_jax_platforms``/``*_host_cores`` provenance; the TP=2 arm is
+    the pure-XLA ``ops/shard_decode.py`` port either way."""
+    import copy
+    import logging
+
+    from cst_captioning_tpu.analysis.jit_registry import (
+        RELAXED_SERVING_MATCH_FLOOR,
+        RELAXED_SERVING_SCORE_RTOL,
+    )
+    from cst_captioning_tpu.config import get_preset
+    from cst_captioning_tpu.data.build import build_dataset
+    from cst_captioning_tpu.decoding.beam import make_beam_search_fn
+    from cst_captioning_tpu.ops import quant
+    from cst_captioning_tpu.serving.engine import InferenceEngine
+
+    n = len(jax.devices())
+    if n < 2:
+        raise RuntimeError(
+            f"lowprec_fused TP arm needs >=2 virtual devices, have {n}"
+        )
+    V = int(os.environ.get("BENCH_LOWPREC_FUSED_VOCAB", "1024"))
+    rounds = int(os.environ.get("BENCH_LOWPREC_FUSED_ROUNDS", "4"))
+    B = int(os.environ.get("BENCH_LOWPREC_FUSED_BATCH", "8"))
+    cfg = get_preset("synthetic_smoke")
+    cfg.serving.warmup = False
+    cfg.serving.max_batch_size = B
+    cfg.serving.batch_shapes = [B]
+    cfg.eval.beam_size = 3
+    cfg.eval.max_decode_len = 12
+    ds, vocab = build_dataset(cfg, cfg.eval.eval_split)
+    cfg.model.vocab_size = max(V, (len(vocab) + 1) // 2 * 2) // 2 * 2
+    base = InferenceEngine(cfg, random_init=True, vocab=vocab)
+    payloads = [
+        {"features": {m: a.tolist() for m, a in ds.features(i).items()}}
+        for i in range(B)
+    ]
+
+    class _Declines(logging.Handler):
+        """Counts ``warn_fused_decline`` lines (models/captioner.py):
+        they all carry the literal "gated off"."""
+
+        def __init__(self):
+            super().__init__()
+            self.count = 0
+
+        def emit(self, record):
+            if "gated off" in record.getMessage():
+                self.count += 1
+
+    declines = {}
+
+    def build_measure(arm, dtype, fused, model_shards=1):
+        c = copy.deepcopy(cfg)
+        c.serving.dtype = dtype
+        c.serving.model_shards = model_shards
+        c.serving.replicas = 1
+        c.model.use_pallas_lstm = fused
+        c.model.use_pallas_attention = fused
+        c.model.use_pallas_sampler = fused
+        c.model.use_pallas_beam = fused
+        h = _Declines()
+        lg = logging.getLogger("cst_captioning_tpu.models")
+        lg.addHandler(h)
+        try:
+            # base.params are float: the int8w ctor quantizes ONCE at
+            # boot, so every arm serves the same logical weights.
+            eng = InferenceEngine(c, params=base.params, vocab=base.vocab)
+            reqs = [eng.prepare(dict(p)) for p in payloads]
+            caps = [
+                r.caption for r in eng.decode_prepared(reqs, store=False)
+            ]
+            times = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                out = eng.decode_prepared(reqs, store=False)
+                times.append(time.perf_counter() - t0)
+            assert [r.caption for r in out] == caps  # steady-state
+        finally:
+            lg.removeHandler(h)
+        if fused:
+            declines[arm] = h.count
+        times.sort()
+        p99 = times[min(len(times) - 1, int(len(times) * 0.99))]
+        return {
+            "eng": eng,
+            "captions": caps,
+            "captions_per_sec": len(reqs) * rounds / sum(times),
+            "p99_batch_ms": p99 * 1e3,
+            "bytes_per_shard": eng.param_bytes_per_shard(),
+            "mesh_shape": eng.describe()["mesh_shape"],
+        }
+
+    def scores(eng):
+        reqs = [eng.prepare(dict(p)) for p in payloads]
+        feats = {
+            m: jnp.asarray(np.stack([r.feats[m] for r in reqs]))
+            for m in reqs[0].feats
+        }
+        masks = {
+            m: jnp.asarray(np.stack([r.masks[m] for r in reqs]))
+            for m in reqs[0].masks
+        }
+        fn = make_beam_search_fn(
+            eng.model,
+            beam_size=cfg.eval.beam_size,
+            max_len=cfg.eval.max_decode_len,
+            length_normalize=cfg.eval.length_normalize,
+        )
+        return np.asarray(
+            fn(eng.params, feats, masks).score, np.float64
+        )
+
+    ARMS = (
+        ("f32_fused", "f32", True),
+        ("int8w_fused", "int8w", True),
+        ("int8w_unfused", "int8w", False),
+    )
+    one = {a: build_measure(a, d, f) for a, d, f in ARMS}
+    tp = {a: build_measure(f"{a}_tp2", d, f, 2) for a, d, f in ARMS}
+
+    # ---- gate 1: int8w adds ZERO declines over the identically-built
+    # f32 arm, on both grids (environmental declines cancel)
+    extra_1dev = declines["int8w_fused"] - declines["f32_fused"]
+    extra_tp2 = (
+        declines["int8w_fused_tp2"] - declines["f32_fused_tp2"]
+    )
+    if extra_1dev or extra_tp2:
+        raise RuntimeError(
+            f"serving.dtype=int8w caused {extra_1dev} extra fused-"
+            f"kernel decline(s) on 1-device and {extra_tp2} on TP=2 "
+            "vs the f32 arm — quantization must never gate a kernel "
+            "off; not recording perf around a silent scan fallback"
+        )
+
+    # ---- gate 2: relaxed-serving parity BEFORE perf is recorded
+    ref = one["int8w_unfused"]["captions"]
+    got = one["int8w_fused"]["captions"]
+    kernel_match = sum(a == b for a, b in zip(ref, got)) / len(ref)
+    if kernel_match < RELAXED_SERVING_MATCH_FLOOR:
+        raise RuntimeError(
+            f"fused-int8w caption match {kernel_match:.3f} vs the "
+            f"unfused int8w reference is below the pinned floor "
+            f"{RELAXED_SERVING_MATCH_FLOOR} — not recording"
+        )
+    f32_match = sum(
+        a == b
+        for a, b in zip(one["f32_fused"]["captions"], got)
+    ) / len(got)
+    if f32_match < RELAXED_SERVING_MATCH_FLOOR:
+        raise RuntimeError(
+            f"fused-int8w caption match {f32_match:.3f} vs the fused "
+            f"f32 arm is below the pinned floor "
+            f"{RELAXED_SERVING_MATCH_FLOOR} — not recording"
+        )
+    s_ref = scores(one["int8w_unfused"]["eng"])
+    s_fused = scores(one["int8w_fused"]["eng"])
+    gap = float(np.max(
+        np.abs(s_fused - s_ref) / np.maximum(np.abs(s_ref), 1e-6)
+    ))
+    if gap > RELAXED_SERVING_SCORE_RTOL:
+        raise RuntimeError(
+            f"fused-int8w per-caption score gap {gap:.4f} vs the "
+            f"unfused reference is above the pinned rtol "
+            f"{RELAXED_SERVING_SCORE_RTOL}"
+        )
+    tp_match = sum(
+        a == b for a, b in zip(got, tp["int8w_fused"]["captions"])
+    ) / len(got)
+    if tp_match < RELAXED_SERVING_MATCH_FLOOR:
+        raise RuntimeError(
+            f"fused-int8w TP=2 captions diverged from the 1-device "
+            f"arm (match {tp_match:.3f})"
+        )
+
+    # ---- gate 3: the streamed vocab tile is EXACTLY 0.25x f32, by
+    # closed form, on both grids, before anything records
+    H = cfg.model.rnn_size
+    Vp = cfg.model.vocab_size
+    f32_tile = H * Vp * 4                        # logit_w, f32
+    int8_tile, scale_bytes = quant.quantized_leaf_bytes((H, Vp), 1)
+    if int8_tile * 4 != f32_tile:
+        raise RuntimeError(
+            f"int8w vocab tile {int8_tile} B is not exactly 0.25x the "
+            f"f32 tile {f32_tile} B — the closed form drifted"
+        )
+    f32_ps = H * (Vp // 2) * 4                   # per TP=2 shard
+    int8_ps, scale_ps = quant.quantized_leaf_bytes((H, Vp // 2), 1)
+    if int8_ps * 4 != f32_ps:
+        raise RuntimeError(
+            f"per-shard int8w vocab tile {int8_ps} B is not exactly "
+            f"0.25x the f32 shard tile {f32_ps} B under TP=2"
+        )
+    p = one["int8w_fused"]["eng"].params
+    p = p["params"] if "params" in p else p
+    measured_tile = int(np.asarray(p["logit_w"]).nbytes)
+    if measured_tile != int8_tile:
+        raise RuntimeError(
+            f"measured int8 logit_w bytes {measured_tile} != closed "
+            f"form {int8_tile} — the byte accounting is dishonest"
+        )
+
+    out = {
+        "lowprec_fused_virtual_devices": n,
+        "lowprec_fused_host_cores": float(os.cpu_count() or 1),
+        "lowprec_fused_xla_flags": os.environ.get("XLA_FLAGS", ""),
+        "lowprec_fused_jax_platforms": os.environ.get(
+            "JAX_PLATFORMS", ""
+        ),
+        "lowprec_fused_mesh_shape": tp["int8w_fused"]["mesh_shape"],
+        "lowprec_fused_vocab": Vp,
+        "lowprec_fused_beam": cfg.eval.beam_size,
+        "lowprec_fused_batch": B,
+        "lowprec_fused_match_floor": RELAXED_SERVING_MATCH_FLOOR,
+        "lowprec_fused_score_rtol": RELAXED_SERVING_SCORE_RTOL,
+        # Closed-form streamed vocab tile: int8 codes are EXACTLY
+        # 0.25x the f32 tile (asserted above); the per-channel scale
+        # rows are the honest small print, on both grids.
+        "lowprec_fused_vocab_tile_f32_bytes": f32_tile,
+        "lowprec_fused_vocab_tile_int8w_bytes": int8_tile,
+        "lowprec_fused_vocab_tile_scale_bytes": scale_bytes,
+        "lowprec_fused_vocab_tile_ratio": round(int8_tile / f32_tile, 6),
+        "lowprec_fused_vocab_tile_measured_bytes": measured_tile,
+        "lowprec_fused_tp2_vocab_tile_f32_bytes": f32_ps,
+        "lowprec_fused_tp2_vocab_tile_int8w_bytes": int8_ps,
+        "lowprec_fused_tp2_vocab_tile_scale_bytes": scale_ps,
+        "lowprec_fused_tp2_vocab_tile_ratio": round(int8_ps / f32_ps, 6),
+        "lowprec_fused_int8w_match_rate": round(kernel_match, 4),
+        "lowprec_fused_int8w_f32_match_rate": round(f32_match, 4),
+        "lowprec_fused_int8w_tp2_match_rate": round(tp_match, 4),
+        "lowprec_fused_int8w_score_gap_max": round(gap, 6),
+        "lowprec_fused_int8w_vs_f32_ratio": round(
+            one["int8w_fused"]["captions_per_sec"]
+            / one["f32_fused"]["captions_per_sec"], 4
+        ),
+        "lowprec_fused_vs_unfused_ratio": round(
+            one["int8w_fused"]["captions_per_sec"]
+            / one["int8w_unfused"]["captions_per_sec"], 4
+        ),
+    }
+    for arm, _d, _f in ARMS:
+        out[f"lowprec_fused_{arm}_captions_per_sec"] = round(
+            one[arm]["captions_per_sec"], 3
+        )
+        out[f"lowprec_fused_{arm}_p99_batch_ms"] = round(
+            one[arm]["p99_batch_ms"], 2
+        )
+        out[f"lowprec_fused_{arm}_param_bytes_per_shard"] = one[arm][
+            "bytes_per_shard"
+        ]
+        out[f"lowprec_fused_{arm}_tp2_captions_per_sec"] = round(
+            tp[arm]["captions_per_sec"], 3
+        )
+        out[f"lowprec_fused_{arm}_tp2_p99_batch_ms"] = round(
+            tp[arm]["p99_batch_ms"], 2
+        )
+        out[f"lowprec_fused_{arm}_tp2_param_bytes_per_shard"] = tp[
+            arm
+        ]["bytes_per_shard"]
+    # Schema-pinned (validate_record): *_extra_declines is EXACTLY 0 —
+    # the raw per-arm counts are environmental (CPU interpret gate)
+    # and recorded under a suffix the pin doesn't bite.
+    out["lowprec_fused_int8w_extra_declines"] = extra_1dev
+    out["lowprec_fused_int8w_tp2_extra_declines"] = extra_tp2
+    for arm, count in declines.items():
+        out[f"lowprec_fused_{arm}_env_gate_lines"] = count
+    return out
+
+
+def bench_lowprec_fused(backend_ok: bool = True):
+    """Fused×int8w composition rows (see
+    :func:`_bench_lowprec_fused_impl`).  Runs inline on a >=2-device
+    host, otherwise re-execs onto a virtual 2-device CPU platform so
+    the TP=2 arm shards a real mesh."""
+    import subprocess
+
+    if backend_ok:
+        try:
+            if len(jax.devices()) >= 2:
+                return _bench_lowprec_fused_impl()
+        except Exception:  # noqa: BLE001 — fall through to the child
+            pass
+    env = dict(os.environ)
+    flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+", "",
+        env.get("XLA_FLAGS", ""),
+    )
+    env["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=2"
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_LOWPREC_FUSED_CHILD"] = "1"
+    here = os.path.abspath(__file__)
+    r = subprocess.run(
+        [sys.executable, here],
+        capture_output=True, text=True, timeout=1200, env=env,
+        cwd=os.path.dirname(here),
+    )
+    lines = [
+        ln for ln in r.stdout.strip().splitlines() if ln.startswith("{")
+    ]
+    if r.returncode != 0 or not lines:
+        tail = (r.stderr or r.stdout).strip().splitlines()
+        raise RuntimeError(
+            f"lowprec_fused child rc={r.returncode}: "
+            f"{tail[-1] if tail else 'no output'}"
+        )
+    out = json.loads(lines[-1])
+    out["lowprec_fused_virtual_cpu"] = 1
+    return out
+
+
 def _bench_spec_impl():
     """Speculative-decode serving rows (the in-process child of
     :func:`bench_spec`; ISSUE 18).
@@ -3331,6 +3702,16 @@ def _bench_spec_impl():
             "draft_params": draft_path,
         }
         spec_eng = InferenceEngine(c, params=base.params, vocab=vocab)
+        # ISSUE 20 composition arm: the SAME draft over int8w-quantized
+        # verify weights (the verifier's batched vocab GEMM rides the
+        # model's quantized logit path).  Built inside the tempdir so
+        # the draft file is still on disk at boot.
+        c8 = copy.deepcopy(c)
+        c8.serving.dtype = "int8w"
+        spec8_eng = InferenceEngine(c8, params=base.params, vocab=vocab)
+        p8 = copy.deepcopy(cfg)
+        p8.serving.dtype = "int8w"
+        plain8_eng = InferenceEngine(p8, params=base.params, vocab=vocab)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
 
@@ -3383,6 +3764,31 @@ def _bench_spec_impl():
             "non-speculative floor; not recording as a win"
         )
 
+    # ---- ISSUE 20 composition row: speculation × int8w.  Token-
+    # exactness is asserted against the PLAIN int8w decoder (same
+    # quantized weights, same rejection rule) — the relaxed-serving
+    # bound lives between int8w and f32, never between spec and plain,
+    # so a single diverged token here is a verifier bug, not noise.
+    got_p8, wall_p8, _tk, _p9, _ = drive(plain8_eng)
+    got_s8, wall_s8, _tk2, _p92, dec8 = drive(spec8_eng)
+    mm8 = sum(
+        1 for i in range(n_reqs)
+        if not np.array_equal(got_s8[i], got_p8[i])
+    )
+    if mm8:
+        raise RuntimeError(
+            f"speculative decode over int8w weights diverged on "
+            f"{mm8}/{n_reqs} requests vs the plain int8w decoder — "
+            "the verify GEMM must ride the same quantized logit path"
+        )
+    st8 = dec8.spec_stats()
+    if st8["tokens_per_round"] <= 1.0:
+        raise RuntimeError(
+            f"int8w speculation emitted {st8['tokens_per_round']:.3f} "
+            "tokens per live slot-round — no better than the "
+            "non-speculative floor; not recording as a win"
+        )
+
     return {
         "spec_host_cores": float(os.cpu_count() or 1),
         "spec_xla_flags": os.environ.get("XLA_FLAGS", ""),
@@ -3406,6 +3812,14 @@ def _bench_spec_impl():
         "spec_baseline_ticks": float(ticks_base),
         "spec_p99_tick_ms": round(p99_spec * 1e3, 3),
         "spec_baseline_p99_tick_ms": round(p99_base * 1e3, 3),
+        "spec_int8w_token_mismatches": float(mm8),
+        "spec_int8w_acceptance_rate": round(st8["acceptance_rate"], 4),
+        "spec_int8w_tokens_per_tick": round(st8["tokens_per_round"], 4),
+        "spec_int8w_captions_per_sec": round(n_reqs / wall_s8, 3),
+        "spec_int8w_baseline_captions_per_sec": round(
+            n_reqs / wall_p8, 3
+        ),
+        "spec_int8w_vs_baseline_ratio": round(wall_p8 / wall_s8, 4),
     }
 
 
@@ -3926,6 +4340,17 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001
             extra["lowprec_error"] = f"{type(e).__name__}: {e}"
         emit()
+    if family_on("LOWPREC_FUSED"):
+        # Fused×int8w composition rows (ISSUE 20): fused-f32 vs
+        # fused-int8w vs unfused-int8w captions/s + p99 on the
+        # 1-device and TP=2 grids — zero fused declines, the
+        # relaxed-serving parity bounds, and the exact 0.25x streamed
+        # vocab tile all asserted BEFORE anything records.
+        try:
+            extra.update(bench_lowprec_fused(backend_ok=ok))
+        except Exception as e:  # noqa: BLE001
+            extra["lowprec_fused_error"] = f"{type(e).__name__}: {e}"
+        emit()
     if family_on("SPEC"):
         # Speculative-decode rows (ISSUE 18): draft-LSTM propose +
         # full-model batched verify on the slot runtime, distilled
@@ -4054,6 +4479,12 @@ if __name__ == "__main__":
         # (bench_lowprec), same virtual-platform discipline.
         jax.config.update("jax_platforms", "cpu")
         print(json.dumps(_bench_lowprec_impl()), flush=True)
+        sys.exit(0)
+    if os.environ.get("BENCH_LOWPREC_FUSED_CHILD") == "1":
+        # Re-exec'd fused×int8w composition child (bench_lowprec_fused),
+        # same virtual-platform discipline.
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(_bench_lowprec_fused_impl()), flush=True)
         sys.exit(0)
     if os.environ.get("BENCH_REPLICA_CHILD") == "1":
         # Re-exec'd replica-sweep child (bench_serving_replicas): the

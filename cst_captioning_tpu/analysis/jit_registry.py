@@ -466,7 +466,11 @@ CAST_REGISTRY: Dict[str, CastSite] = {
     "ops/pallas_beam.py::_make_beam_kernel.kernel.vloop": CastSite(
         "token-exact",
         "per-V-tile logits: cdt matmul with f32 accumulation then f32 "
-        "candidate scores — the streamed top-K operates on f32 only",
+        "candidate scores — the streamed top-K operates on f32 only; "
+        "int8w mode dequantizes the streamed code tile in-kernel "
+        "(codes cast losslessly to cdt, per-logit scale applied to the "
+        "f32 accumulator, f32 bias, no cdt rounding — quant_matmul "
+        "semantics, relaxed-serving bounded vs unfused int8w)",
         low_precision=True,
     ),
     "ops/pallas_beam.py::_beam_impl": CastSite(
@@ -480,10 +484,21 @@ CAST_REGISTRY: Dict[str, CastSite] = {
         "f32 mantissa bits — the bit-exact pinned sampler stream "
         "(PARITY r7); every cast is integer/bit manipulation",
     ),
-    "ops/pallas_sampler.py::_masked_vocab": CastSite(
+    "ops/pallas_sampler.py::_decode_bias": CastSite(
         "token-exact",
-        "vocab-mask widening to f32 before the NEG_INF select — {0,1} "
-        "exact",
+        "decode-policy bias staging (shared by the float and int8 "
+        "vocab paddings): b_out widened to f32 before the NEG_INF "
+        "masking — exact widening, no rounding",
+    ),
+    "ops/pallas_sampler.py::_masked_vocab_q": CastSite(
+        "relaxed-serving",
+        "int8 vocab-tile staging: per-logit scales widened to f32 with "
+        "unit scales + zero codes in the padded tail (0 * scale + "
+        "NEG_INF bias keeps padding inert in max/LSE exactly like the "
+        "float padding); the in-kernel dequant these scales feed is "
+        "quant_matmul semantics, bounded by "
+        "RELAXED_SERVING_MATCH_FLOOR / _SCORE_RTOL",
+        low_precision=True,
     ),
     "ops/pallas_sampler.py::_make_sample_kernel.kernel": CastSite(
         "token-exact",
@@ -493,7 +508,11 @@ CAST_REGISTRY: Dict[str, CastSite] = {
     ),
     "ops/pallas_sampler.py::_make_sample_kernel.kernel.vloop": CastSite(
         "token-exact",
-        "per-V-tile logits + Gumbel keys in f32 over cdt matmul tiles",
+        "per-V-tile logits + Gumbel keys in f32 over cdt matmul tiles; "
+        "int8w mode dequantizes the streamed code tile in-kernel "
+        "(scale after the f32 accumulation, f32 bias, no cdt rounding "
+        "— quant_matmul semantics, relaxed-serving bounded vs unfused "
+        "int8w)",
         low_precision=True,
     ),
     "ops/pallas_sampler.py::_sample_impl": CastSite(
@@ -530,6 +549,15 @@ CAST_REGISTRY: Dict[str, CastSite] = {
         low_precision=True,
     ),
     # ----------------------------------------------------- shard_decode
+    "ops/shard_decode.py::_emb_psum": CastSite(
+        "relaxed-serving",
+        "sharded int8w embedding gather: the shard's gathered int8 "
+        "rows reconstruct in f32 (code x per-row scale slice) then "
+        "round ONCE to cdt BEFORE the mask + psum — dequant_rows "
+        "semantics per shard, and the psum only adds exact zeros from "
+        "non-owner shards; float mode has no cast here",
+        low_precision=True,
+    ),
     "ops/shard_decode.py::_attention_ctx": CastSite(
         "token-exact",
         "shard_map port of the attention helper: same cdt/f32 "

@@ -23,6 +23,10 @@ shapes pass ``beam_shapes_ok``, the whole recurrence dispatches to the
 fused Pallas kernel (``ops/pallas_beam.py``) instead of the per-step
 scan — same semantics, same :func:`finalize_beams` epilogue, declared
 token-exact at float32 (docs/PARITY.md records the tie-order contract).
+The path composes with ``serving.dtype=int8w``: quantized models hand
+the kernel int8 code tiles plus per-channel scales and it dequantizes
+in-kernel (f32-pinned accumulation, scale after — ``quant_matmul``
+semantics), streaming vocab tiles at a quarter of the f32 bytes.
 """
 
 from __future__ import annotations

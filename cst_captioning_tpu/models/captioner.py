@@ -140,10 +140,15 @@ class CaptionModel(nn.Module):
     # float32 `<name>_scale` sibling leaves (declared in setup below, filled
     # by quant.quantize_params at engine boot or artifact build), and the
     # cdt-surface methods (_encode/_context/_step/_logits) apply them via
-    # the scale-after-f32-accumulation helpers.  Decisions stay f32; parity
-    # is the `relaxed-serving` tier (analysis/jit_registry.py).  Fresh
-    # `init` still produces float weights + ones scales — the quant branch
-    # is numerically the bf16 path until quantize_params runs.
+    # the scale-after-f32-accumulation helpers.  The fused Pallas paths
+    # COMPOSE with this flag: the kernels stream the int8 code tiles plus
+    # their scale rows and dequantize in-kernel with the same
+    # quant_matmul semantics (ops/pallas_sampler.py, ops/pallas_beam.py,
+    # ops/pallas_lstm.py, ops/pallas_attlstm.py), so int8w keeps the
+    # VMEM-resident recurrence AND the 0.25x vocab tile.  Decisions stay
+    # f32; parity is the `relaxed-serving` tier (analysis/jit_registry.py).
+    # Fresh `init` still produces float weights + ones scales — the quant
+    # branch is numerically the bf16 path until quantize_params runs.
     weight_quant: bool = False
     use_pallas: bool = False      # fused LSTM recurrence kernel fast path
     use_pallas_attention: bool = False  # fused Bahdanau attention step kernel
@@ -190,21 +195,6 @@ class CaptionModel(nn.Module):
     # ---------------------------------------------------------------- setup
     def setup(self):
         assert len(self.modalities) == len(self.feature_dims)
-        if self.weight_quant and (
-            self.use_pallas
-            or self.use_pallas_attention
-            or self.use_pallas_sampler
-            or self.use_pallas_beam
-        ):
-            # The fused kernels stream raw float weight tiles; under
-            # weight_quant the kernel tiles would be int8 codes read as
-            # floats.  model_from_config gates these off with a logged
-            # decline — reaching here means a hand-built model skipped it.
-            raise ValueError(
-                "weight_quant (serving.dtype=int8w) is incompatible with "
-                "the fused Pallas kernel paths — they read raw weight "
-                "tiles; build via model_from_config, which declines them"
-            )
         pdt = jnp.dtype(self.param_dtype)
         E, H, A, V = (
             self.embed_size,
@@ -618,10 +608,18 @@ class CaptionModel(nn.Module):
         """Batched-input-GEMM + Pallas recurrence path (meanpool fusion,
         no scheduled sampling).  Numerics per ``ops/rnn.py``: bf16 matmuls
         with float32 gate accumulation and float32 cell state."""
-        from cst_captioning_tpu.ops.pallas_lstm import lstm_recurrence
+        from cst_captioning_tpu.ops.pallas_lstm import (
+            lstm_recurrence,
+            lstm_recurrence_quant,
+        )
 
         cdt = jnp.dtype(self.compute_dtype)
-        emb = self.word_embed.astype(cdt)[input_ids]           # (B, T, E)
+        if self.weight_quant:
+            emb = dequant_rows(
+                self.word_embed, self.word_embed_scale, input_ids, cdt
+            )                                                  # (B, T, E)
+        else:
+            emb = self.word_embed.astype(cdt)[input_ids]       # (B, T, E)
         # Static per-video rows (context + category) hit their kernel rows
         # ONCE per batch row, not once per timestep: gx = emb @ Wx_emb +
         # (static @ Wx_static + b) broadcast over T.
@@ -631,12 +629,19 @@ class CaptionModel(nn.Module):
         x = emb
         for layer in range(self.num_layers):
             w, b = self.lstm[layer]
+            ws = self.lstm_scales[layer] if self.weight_quant else None
             dx = x.shape[-1]
+            # Under weight_quant the row slices are int8 codes sharing
+            # one (4H,) per-channel scale; each slice's f32-pinned GEMM
+            # is scaled AFTER its accumulation — the scale distributes
+            # over the row-split sum (quant_matmul semantics).
             wx = w[:dx].astype(cdt)
             gx = jnp.einsum(
                 "btd,dg->btg", x.astype(cdt), wx,
                 preferred_element_type=jnp.float32,
             )
+            if self.weight_quant:
+                gx = gx * ws.astype(jnp.float32)[None, None, :]
             if layer == 0:
                 d_in = dx + static.shape[-1]
                 w_static = w[dx:d_in].astype(cdt)
@@ -644,12 +649,18 @@ class CaptionModel(nn.Module):
                     "bd,dg->bg", static, w_static,
                     preferred_element_type=jnp.float32,
                 )
+                if self.weight_quant:
+                    gstatic = gstatic * ws.astype(jnp.float32)[None, :]
                 gx = gx + gstatic[:, None, :]
             else:
                 d_in = dx
             gx = gx + b.astype(jnp.float32)
-            wh = w[d_in:].astype(cdt)
-            x = lstm_recurrence(gx, wh, True)
+            if self.weight_quant:
+                x = lstm_recurrence_quant(
+                    gx, w[d_in:], ws, compute_dtype=cdt, use_pallas=True
+                )
+            else:
+                x = lstm_recurrence(gx, w[d_in:].astype(cdt), True)
         return x
 
     def _fused_attention_forward(
@@ -660,23 +671,54 @@ class CaptionModel(nn.Module):
         attention-query + context + gate chain in the fused kernel.
         Weight-row layout follows ``_step``'s concat order
         [emb | ctx | cat | hidden]."""
-        from cst_captioning_tpu.ops.pallas_attlstm import attlstm_recurrence
+        from cst_captioning_tpu.ops.pallas_attlstm import (
+            attlstm_recurrence,
+            attlstm_recurrence_quant,
+        )
 
         cdt = jnp.dtype(self.compute_dtype)
-        emb = self.word_embed.astype(cdt)[input_ids]        # (B, T, E)
         w, b = self.lstm[0]
         E = self.embed_size
         C = cache.cat_emb.shape[-1]
+        ws = self.lstm_scales[0] if self.weight_quant else None
+        if self.weight_quant:
+            emb = dequant_rows(
+                self.word_embed, self.word_embed_scale, input_ids, cdt
+            )                                               # (B, T, E)
+        else:
+            emb = self.word_embed.astype(cdt)[input_ids]    # (B, T, E)
         gx = jnp.einsum(
             "bte,eg->btg", emb, w[:E].astype(cdt),
             preferred_element_type=jnp.float32,
-        ) + b.astype(jnp.float32)
+        )
+        if self.weight_quant:
+            gx = gx * ws.astype(jnp.float32)[None, None, :]
+        gx = gx + b.astype(jnp.float32)
         if C:
-            gx = gx + jnp.einsum(
+            gcat = jnp.einsum(
                 "bc,cg->bg", cache.cat_emb,
                 w[2 * E : 2 * E + C].astype(cdt),
                 preferred_element_type=jnp.float32,
-            )[:, None, :]
+            )
+            if self.weight_quant:
+                gcat = gcat * ws.astype(jnp.float32)[None, :]
+            gx = gx + gcat[:, None, :]
+        if self.weight_quant:
+            # int8 code slices + their scales stream into the kernel;
+            # dequant happens in-kernel (ops/pallas_attlstm.py).
+            return attlstm_recurrence_quant(
+                gx,
+                w[2 * E + C :],
+                w[E : 2 * E],
+                ws,
+                self.att_wh,
+                self.att_wh_scale,
+                self.att_v.astype(cdt),
+                cache.att_proj,
+                cache.att_mask,
+                cache.att_vals,
+                cdt,
+            )
         return attlstm_recurrence(
             gx,
             w[2 * E + C :].astype(cdt),
@@ -942,11 +984,16 @@ class CaptionModel(nn.Module):
             b.astype(jnp.float32)[None, :], (B, b.shape[0])
         )
         if C:
-            gx_static = gx_static + jnp.einsum(
+            gcat = jnp.einsum(
                 "bc,cg->bg", cache.cat_emb,
                 w[2 * E : 2 * E + C].astype(cdt),
                 preferred_element_type=jnp.float32,
             )
+            if self.weight_quant:
+                # Category rows are layer-0 kernel rows: int8 codes
+                # sharing the (4H,) lstm scale, applied post-accumulation.
+                gcat = gcat * self.lstm_scales[0].astype(jnp.float32)[None, :]
+            gx_static = gx_static + gcat
         return gx_static
 
     def fused_beam(
@@ -985,6 +1032,13 @@ class CaptionModel(nn.Module):
             max_len=max_len,
             suppress_unk=self.decode_suppress_unk,
         )
+        if self.weight_quant:
+            # int8w: weights stay int8 codes — the kernel dequantizes
+            # in-kernel from the streamed scale rows (0.25x vocab tile).
+            wcast = lambda x: x  # noqa: E731
+            common["compute_dtype"] = self.compute_dtype
+        else:
+            wcast = lambda x: x.astype(cdt)  # noqa: E731
         if self.decode_shards > 1:
             from cst_captioning_tpu.ops.shard_decode import (
                 sharded_attlstm_beam,
@@ -1000,32 +1054,47 @@ class CaptionModel(nn.Module):
                 axis=self.decode_axis,
             )
         if self.fusion == "attention":
+            if self.weight_quant:
+                common["quant"] = (
+                    self.word_embed_scale,
+                    self.logit_w_scale,
+                    self.lstm_scales[0],
+                    self.att_wh_scale,
+                )
             return attlstm_beam(
                 gx_static,
-                w[:E].astype(cdt),
-                w[2 * E + C :].astype(cdt),
-                w[E : 2 * E].astype(cdt),
-                self.att_wh.astype(cdt),
+                wcast(w[:E]),
+                wcast(w[2 * E + C :]),
+                wcast(w[E : 2 * E]),
+                wcast(self.att_wh),
                 self.att_v.astype(cdt),
                 cache.att_proj,
                 cache.att_mask,
                 cache.att_vals,
-                self.word_embed.astype(cdt),
-                self.logit_w.astype(cdt),
+                wcast(self.word_embed),
+                wcast(self.logit_w),
                 self.logit_b.astype(jnp.float32),
                 **common,
             )
-        gx_static = gx_static + jnp.einsum(
+        gctx = jnp.einsum(
             "be,eg->bg", cache.ctx_static.astype(cdt),
             w[E : 2 * E].astype(cdt),
             preferred_element_type=jnp.float32,
         )
+        if self.weight_quant:
+            gctx = gctx * self.lstm_scales[0].astype(jnp.float32)[None, :]
+            common["quant"] = (
+                self.word_embed_scale,
+                self.logit_w_scale,
+                self.lstm_scales[0],
+            )
+        gx_static = gx_static + gctx
         return lstm_beam(
             gx_static,
-            w[:E].astype(cdt),
-            w[2 * E + C :].astype(cdt),
-            self.word_embed.astype(cdt),
-            self.logit_w.astype(cdt),
+            wcast(w[:E]),
+            wcast(w[2 * E + C :]),
+            wcast(self.word_embed),
+            wcast(self.logit_w),
             self.logit_b.astype(jnp.float32),
             **common,
         )
@@ -1085,35 +1154,59 @@ class CaptionModel(nn.Module):
             temperature=temperature,
             suppress_unk=self.decode_suppress_unk,
         )
+        if self.weight_quant:
+            # int8w: weights stay int8 codes — the kernel dequantizes
+            # in-kernel from the streamed scale rows (0.25x vocab tile).
+            wcast = lambda x: x  # noqa: E731
+            common["compute_dtype"] = self.compute_dtype
+        else:
+            wcast = lambda x: x.astype(cdt)  # noqa: E731
         if self.fusion == "attention":
+            if self.weight_quant:
+                common["quant"] = (
+                    self.word_embed_scale,
+                    self.logit_w_scale,
+                    self.lstm_scales[0],
+                    self.att_wh_scale,
+                )
             toks, lps, mask = attlstm_sample(
                 gx_static,
-                w[:E].astype(cdt),
-                w[2 * E + C :].astype(cdt),
-                w[E : 2 * E].astype(cdt),
-                self.att_wh.astype(cdt),
+                wcast(w[:E]),
+                wcast(w[2 * E + C :]),
+                wcast(w[E : 2 * E]),
+                wcast(self.att_wh),
                 self.att_v.astype(cdt),
                 cache.att_proj,
                 cache.att_mask,
                 cache.att_vals,
-                self.word_embed.astype(cdt),
-                self.logit_w.astype(cdt),
+                wcast(self.word_embed),
+                wcast(self.logit_w),
                 self.logit_b.astype(jnp.float32),
                 seed,
                 **common,
             )
         else:
-            gx_static = gx_static + jnp.einsum(
+            gctx = jnp.einsum(
                 "be,eg->bg", cache.ctx_static.astype(cdt),
                 w[E : 2 * E].astype(cdt),
                 preferred_element_type=jnp.float32,
             )
+            if self.weight_quant:
+                gctx = gctx * self.lstm_scales[0].astype(
+                    jnp.float32
+                )[None, :]
+                common["quant"] = (
+                    self.word_embed_scale,
+                    self.logit_w_scale,
+                    self.lstm_scales[0],
+                )
+            gx_static = gx_static + gctx
             toks, lps, mask = lstm_sample(
                 gx_static,
-                w[:E].astype(cdt),
-                w[2 * E + C :].astype(cdt),
-                self.word_embed.astype(cdt),
-                self.logit_w.astype(cdt),
+                wcast(w[:E]),
+                wcast(w[2 * E + C :]),
+                wcast(self.word_embed),
+                wcast(self.logit_w),
                 self.logit_b.astype(jnp.float32),
                 seed,
                 **common,
@@ -1156,8 +1249,11 @@ def model_from_config(cfg, mesh=None, serving_dtype=None) -> CaptionModel:
     trainer, so ``f32``/``None`` leaves the model byte-identical to
     today's build.  ``bf16`` forces ``compute_dtype=bfloat16``; ``int8w``
     additionally sets ``weight_quant`` (int8 codes + per-channel scales,
-    ops/quant.py) and declines every fused Pallas kernel — they stream
-    raw float weight tiles that no longer exist.
+    ops/quant.py).  The fused Pallas kernels COMPOSE with ``int8w``: they
+    stream the int8 code tiles plus per-channel scale rows and dequantize
+    in-kernel with ``quant_matmul`` semantics, so the same structural
+    gates apply as for float serving (layer count, mesh shape, shape
+    tables) and quantization itself never declines a kernel.
     """
     m, d = cfg.model, cfg.data
     if serving_dtype is not None and serving_dtype not in SERVING_DTYPES:
@@ -1192,20 +1288,6 @@ def model_from_config(cfg, mesh=None, serving_dtype=None) -> CaptionModel:
     )
     use_pallas_attention = getattr(m, "use_pallas_attention", False)
     use_pallas_lstm = m.use_pallas_lstm
-    if weight_quant and (use_pallas_attention or use_pallas_lstm):
-        for flag, on in (
-            ("use_pallas_attention", use_pallas_attention),
-            ("use_pallas_lstm", use_pallas_lstm),
-        ):
-            if on:
-                warn_fused_decline(
-                    flag,
-                    "serving.dtype=int8w — fused kernels read raw float "
-                    "weight tiles, which weight-only quantization "
-                    "replaces",
-                )
-        use_pallas_attention = False
-        use_pallas_lstm = False
 
     # The fused sampler and beam kernels are gated by the CAPABILITY
     # TABLE (decoding/core.py::DECODE_KERNEL_CAPS, machine-checked by
@@ -1226,17 +1308,6 @@ def model_from_config(cfg, mesh=None, serving_dtype=None) -> CaptionModel:
 
     def _decode_kernel_gate(flag_name: str) -> bool:
         if not getattr(m, flag_name, False):
-            return False
-        if weight_quant:
-            # The fused kernels stream raw float weight tiles from HBM;
-            # under int8w those tiles are quantized codes + separate
-            # scales, which no kernel reads.  The scan path's quant
-            # branches are the int8w fast path.
-            warn_fused_decline(
-                flag_name,
-                "serving.dtype=int8w — fused kernels read raw float "
-                "weight tiles, which weight-only quantization replaces",
-            )
             return False
         if m.num_layers != 1:
             # The in-model gate would decline anyway; say so up front.

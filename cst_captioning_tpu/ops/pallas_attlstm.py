@@ -207,9 +207,17 @@ def attlstm_scan(
 
 # ------------------------------------------------------------ forward kernel
 
-def _make_fwd_kernel(with_residuals: bool):
+def _make_fwd_kernel(with_residuals: bool, quant: bool = False,
+                     cdt=None):
     def kernel(gx_ref, wh_ref, wctx_ref, awh_ref, av_ref, proj_ref,
                mask_ref, vals_ref, *refs):
+        refs = list(refs)
+        # int8w mode appends the scale rows after the float operands:
+        # ls_ref (1, 4H) is the shared per-gate-channel lstm scale (wh
+        # and w_ctx are row slices of one quantized matrix), as_ref
+        # (1, A) the attention-query scale.  See ops/quant.py.
+        ls_ref = refs.pop(0) if quant else None
+        as_ref = refs.pop(0) if quant else None
         if with_residuals:
             h_out_ref, a_out_ref, c_out_ref, h_scr, c_scr = refs
         else:
@@ -221,11 +229,14 @@ def _make_fwd_kernel(with_residuals: bool):
             h_scr[:] = jnp.zeros_like(h_scr)
             c_scr[:] = jnp.zeros_like(c_scr)
 
-        cdt = wh_ref.dtype
         Tc = gx_ref.shape[0]
-        wh = wh_ref[:]
-        wctx = wctx_ref[:]
-        awh = awh_ref[:]
+        # int8 codes dequantize by casting into the activation dtype
+        # (lossless: |code| <= 127) and scaling AFTER the f32-pinned
+        # accumulation — quant_matmul semantics, scale distributes over
+        # the dot.
+        wh = wh_ref[:].astype(cdt) if quant else wh_ref[:]
+        wctx = wctx_ref[:].astype(cdt) if quant else wctx_ref[:]
+        awh = awh_ref[:].astype(cdt) if quant else awh_ref[:]
         vvec = av_ref[:].astype(jnp.float32)[:, 0]      # (A,)
         proj = proj_ref[:]                              # (bt, F, A) cdt
         maskf = mask_ref[:]                             # (bt, F) f32
@@ -241,6 +252,8 @@ def _make_fwd_kernel(with_residuals: bool):
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quant:
+                q = q * as_ref[:]
             th = jnp.tanh(proj + q.astype(cdt)[:, None, :])  # (bt, F, A)
             if score_mxu:
                 # Counter-attempt (see SCORE_MXU): (bt·F, A)@(A, 1)
@@ -259,19 +272,23 @@ def _make_fwd_kernel(with_residuals: bool):
             e = jnp.exp(s - m)
             a = e / jnp.sum(e, axis=-1, keepdims=True)   # (bt, F) f32
             ctx = jnp.sum(a[:, :, None] * vals, axis=1)  # (bt, E) f32
-            gates = (
-                gx_ref[tt].astype(jnp.float32)
-                + jax.lax.dot_general(
-                    ctx.astype(cdt), wctx,
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
-                + jax.lax.dot_general(
-                    h.astype(cdt), wh,
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                )
+            g_ctx = jax.lax.dot_general(
+                ctx.astype(cdt), wctx,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
             )
+            g_h = jax.lax.dot_general(
+                h.astype(cdt), wh,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+            if quant:
+                # Per-operand scale after each f32 accumulation: the
+                # shared (4H,) scale distributes over the row-split sum,
+                # matching the unfused path's single fused quant GEMM.
+                g_ctx = g_ctx * ls_ref[:]
+                g_h = g_h * ls_ref[:]
+            gates = gx_ref[tt].astype(jnp.float32) + g_ctx + g_h
             h_new, c_new = _gate_update(gates, c_scr[:])
             h_scr[:] = h_new
             c_scr[:] = c_new
@@ -287,11 +304,14 @@ def _make_fwd_kernel(with_residuals: bool):
 
 
 def _fwd_call(gx, wh, w_ctx, att_wh, att_v, att_proj, att_mask, att_vals,
-              bt: int, tc: int, with_residuals: bool = True):
+              bt: int, tc: int, with_residuals: bool = True,
+              lstm_scale=None, att_scale=None, compute_dtype=None):
     B, T, G = gx.shape
     H = wh.shape[0]
     F, A = att_proj.shape[1], att_proj.shape[2]
     E = att_vals.shape[-1]
+    quant = lstm_scale is not None
+    cdt = jnp.dtype(compute_dtype) if quant else wh.dtype
     grid = (B // bt, T // tc)
     tm = lambda w: pl.BlockSpec(  # noqa: E731  time-major streams
         (tc, bt, w), lambda b, t: (t, b, 0), memory_space=pltpu.VMEM
@@ -303,27 +323,38 @@ def _fwd_call(gx, wh, w_ctx, att_wh, att_v, att_proj, att_mask, att_vals,
         (r, w), lambda b, t: (0, 0), memory_space=pltpu.VMEM
     )
     out_specs = [tm(H)]
-    out_shape = [jax.ShapeDtypeStruct((T, B, H), wh.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H), cdt)]
     if with_residuals:
         out_specs += [tm(F), tm(H)]
         out_shape += [
             jax.ShapeDtypeStruct((T, B, F), jnp.float32),
             jax.ShapeDtypeStruct((T, B, H), jnp.float32),
         ]
+    in_specs = [
+        tm(G),
+        const2(H, G),
+        const2(E, G),
+        const2(H, A),
+        const2(A, 1),
+        per_b3(F, A),
+        pl.BlockSpec((bt, F), lambda b, t: (b, 0),
+                     memory_space=pltpu.VMEM),
+        per_b3(F, E),
+    ]
+    args = [
+        jnp.swapaxes(gx, 0, 1), wh, w_ctx, att_wh, att_v, att_proj,
+        att_mask.astype(jnp.float32), att_vals,
+    ]
+    if quant:
+        in_specs += [const2(1, G), const2(1, A)]
+        args += [
+            lstm_scale.astype(jnp.float32)[None, :],
+            att_scale.astype(jnp.float32)[None, :],
+        ]
     outs = pl.pallas_call(
-        _make_fwd_kernel(with_residuals),
+        _make_fwd_kernel(with_residuals, quant=quant, cdt=cdt),
         grid=grid,
-        in_specs=[
-            tm(G),
-            const2(H, G),
-            const2(E, G),
-            const2(H, A),
-            const2(A, 1),
-            per_b3(F, A),
-            pl.BlockSpec((bt, F), lambda b, t: (b, 0),
-                         memory_space=pltpu.VMEM),
-            per_b3(F, E),
-        ],
+        in_specs=in_specs,
         out_specs=out_specs,
         out_shape=out_shape,
         scratch_shapes=[
@@ -331,10 +362,7 @@ def _fwd_call(gx, wh, w_ctx, att_wh, att_v, att_proj, att_mask, att_vals,
             pltpu.VMEM((bt, H), jnp.float32),
         ],
         interpret=_interpret(),
-    )(
-        jnp.swapaxes(gx, 0, 1), wh, w_ctx, att_wh, att_v, att_proj,
-        att_mask.astype(jnp.float32), att_vals,
-    )
+    )(*args)
     if with_residuals:
         return tuple(jnp.swapaxes(o, 0, 1) for o in outs)
     return jnp.swapaxes(outs[0], 0, 1), None, None
@@ -591,3 +619,88 @@ def _vjp_bwd(res, dh_out):
 
 
 attlstm_recurrence.defvjp(_vjp_fwd, _vjp_bwd)
+
+
+# ------------------------------------------------- int8 weight-only variants
+
+def attlstm_scan_quant(gx, wh_q, w_ctx_q, lstm_scale, att_wh_q, att_scale,
+                       att_v, att_proj, att_mask, att_vals, compute_dtype):
+    """Chunk-faithful XLA twin of the int8w fused forward.
+
+    ``wh_q`` (H, 4H) / ``w_ctx_q`` (E, 4H) are int8 row slices of the
+    layer's one quantized gate matrix and share the (4H,) per-channel
+    ``lstm_scale``; ``att_wh_q`` (H, A) int8 carries its own (A,)
+    ``att_scale``.  ``att_v``/``att_proj``/``att_vals`` stay float
+    (never quantized — see ops/quant.py's axis table).  Mirrors the
+    kernel op-for-op: codes cast losslessly into the activation dtype,
+    every dot pins f32 accumulation, the scale multiplies AFTER the
+    accumulation (quant_matmul semantics; the shared scale distributes
+    over the wh/w_ctx row-split sum), and the carried (h, c) stays f32
+    with only the emitted h_seq rounding to the activation dtype.
+    """
+    cdt = jnp.dtype(compute_dtype)
+    B = gx.shape[0]
+    H = wh_q.shape[0]
+    maskf = att_mask.astype(jnp.float32)
+    vvec = att_v.astype(jnp.float32)[:, 0]
+    ls = lstm_scale.astype(jnp.float32)[None, :]
+    asc = att_scale.astype(jnp.float32)[None, :]
+    wh = wh_q.astype(cdt)
+    wctx = w_ctx_q.astype(cdt)
+    awh = att_wh_q.astype(cdt)
+
+    def step(carry, gx_t):
+        h, c = carry  # float32
+        q = jax.lax.dot_general(
+            h.astype(cdt), awh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * asc
+        th = jnp.tanh(att_proj + q.astype(cdt)[:, None, :])
+        s = jnp.sum(th.astype(jnp.float32) * vvec[None, None, :], axis=-1)
+        s = jnp.where(maskf > 0, s, NEG_INF)
+        a = jax.nn.softmax(s, axis=-1)
+        ctx = jnp.sum(
+            a[:, :, None] * att_vals.astype(jnp.float32), axis=1
+        )
+        g_ctx = jax.lax.dot_general(
+            ctx.astype(cdt), wctx,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * ls
+        g_h = jax.lax.dot_general(
+            h.astype(cdt), wh,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * ls
+        gates = gx_t + g_ctx + g_h
+        h_new, c_new = _gate_update(gates, c)
+        return (h_new, c_new), h_new
+
+    zeros = jnp.zeros((B, H), jnp.float32)
+    (_, _), h_seq = jax.lax.scan(
+        step, (zeros, zeros), jnp.swapaxes(gx, 0, 1).astype(jnp.float32)
+    )
+    return jnp.swapaxes(h_seq, 0, 1).astype(cdt)
+
+
+def attlstm_recurrence_quant(gx, wh_q, w_ctx_q, lstm_scale, att_wh_q,
+                             att_scale, att_v, att_proj, att_mask,
+                             att_vals, compute_dtype):
+    """Fused int8w attention-LSTM forward (serving only: no custom_vjp —
+    quantized weights serve, they never train).  Same gate
+    (``attlstm_shapes_ok``) and tile picker as the float forward; tiles
+    are picked on the ACTIVATION itemsize so the quant grid geometry
+    matches the float one exactly and only the streamed weight bytes
+    shrink.  Argument shapes as ``attlstm_scan_quant``."""
+    F, A = att_proj.shape[1], att_proj.shape[2]
+    E = att_vals.shape[-1]
+    H = wh_q.shape[0]
+    bt = _pick_bt(gx.shape[0], 64, F, A, E, H, att_proj.dtype.itemsize)
+    h_seq, _, _ = _fwd_call(
+        gx, wh_q, w_ctx_q, att_wh_q, att_v, att_proj, att_mask, att_vals,
+        bt, 1, with_residuals=False,
+        lstm_scale=lstm_scale, att_scale=att_scale,
+        compute_dtype=compute_dtype,
+    )
+    return h_seq

@@ -59,9 +59,16 @@ NEG_INF padding, duplicated vocab rows) are exact in both paths and
 resolve identically by flat-key order.  docs/PARITY.md records this.
 
 Scope: single-layer attention-fusion or meanpool decoders decoding from
-zero state — the flagship eval configs.  Gated by ``beam_shapes_ok``
-(and TPU-backend-gated in ``model_from_config``); every decline falls
-back to the scan path with identical semantics.
+zero state — the flagship eval configs — at f32/bf16 activations with
+float OR int8 weight-only (``serving.dtype=int8w``) weights: the int8w
+path streams int8 vocab/weight code tiles plus per-channel scales and
+dequantizes in-kernel with ``ops/quant.py::quant_matmul`` semantics
+(scale after the f32-pinned accumulation), so quantized serving rides
+the same VMEM-resident recurrence.  Gated by ``beam_shapes_ok`` (and
+TPU-backend-gated in ``model_from_config``); the remaining declines are
+structural — multi-layer decoders, sharded frame axes, batch-sharded
+data meshes, shapes that fail the VMEM/lane gate — and every decline
+falls back to the scan path with identical semantics.
 """
 
 from __future__ import annotations
@@ -78,7 +85,11 @@ from jax.experimental.pallas import tpu as pltpu
 
 from cst_captioning_tpu.constants import BOS_ID, EOS_ID, PAD_ID
 from cst_captioning_tpu.ops.pallas_lstm import _gate_update
-from cst_captioning_tpu.ops.pallas_sampler import _interpret, _masked_vocab
+from cst_captioning_tpu.ops.pallas_sampler import (
+    _interpret,
+    _masked_vocab,
+    _masked_vocab_q,
+)
 
 NEG_INF = -1e30
 # Sentinel strictly below any real candidate (live totals are > -2e30;
@@ -230,21 +241,42 @@ def _onehot_parent(parent, K: int):
 # ----------------------------------------------------------------- kernel
 
 def _make_beam_kernel(btv: int, K: int, Kt: int, Vt: int, T: int, V: int,
-                      V_pad: int, static_ctx: bool = False):
+                      V_pad: int, cdt, static_ctx: bool = False,
+                      quant: bool = False):
     rt = btv * K
 
     def kernel(gxs_ref, wx_ref, wh_ref, *rest):
+        # Positional unpack shared by all four variants (attention/
+        # static-context x float/int8w) — mirrors the sampler kernel.
+        rest = list(rest)
+        ls_ref = rest.pop(0) if quant else None     # lstm scale (1, 4H)
         if static_ctx:
-            (bout_ref, emb_hbm, wout_hbm, seq_out, sc_out,
-             h_scr, c_scr, fin_scr, score_scr, seq_scr, tokv_scr,
-             toks_smem, emb_scr, wout_scr, sem_emb, sem_w, sem_tok) = rest
+            wctx_ref = awh_ref = as_ref = av_ref = None
+            proj_ref = mask_ref = vals_ref = None
         else:
-            (wctx_ref, awh_ref, av_ref, proj_ref, mask_ref, vals_ref,
-             bout_ref, emb_hbm, wout_hbm, seq_out, sc_out,
-             h_scr, c_scr, fin_scr, score_scr, seq_scr, tokv_scr,
-             toks_smem, emb_scr, wout_scr, sem_emb, sem_w, sem_tok) = rest
+            wctx_ref = rest.pop(0)
+            awh_ref = rest.pop(0)
+            as_ref = rest.pop(0) if quant else None  # att scale (1, A)
+            av_ref = rest.pop(0)
+            proj_ref = rest.pop(0)
+            mask_ref = rest.pop(0)
+            vals_ref = rest.pop(0)
+        bout_ref = rest.pop(0)
+        ws_ref = rest.pop(0) if quant else None     # w_out scale (1, V_pad)
+        emb_hbm = rest.pop(0)
+        embs_hbm = rest.pop(0) if quant else None   # emb scale (V, 1) HBM
+        wout_hbm = rest.pop(0)
+        seq_out, sc_out = rest[0], rest[1]
+        rest = rest[2:]
+        (h_scr, c_scr, fin_scr, score_scr, seq_scr, tokv_scr,
+         toks_smem, emb_scr) = rest[:8]
+        rest = rest[8:]
+        embs_scr = rest.pop(0) if quant else None   # gathered emb scales
+        wout_scr = rest.pop(0)
+        sem_emb = rest.pop(0)
+        sem_embs = rest.pop(0) if quant else None
+        sem_w, sem_tok = rest[0], rest[1]
         t = pl.program_id(1)
-        cdt = wh_ref.dtype
 
         @pl.when(t == 0)
         def _():
@@ -267,18 +299,28 @@ def _make_beam_kernel(btv: int, K: int, Kt: int, Vt: int, T: int, V: int,
             pltpu.make_async_copy(
                 emb_hbm.at[toks_smem[i, 0]], emb_scr.at[i], sem_emb.at[i]
             ).start()
+            if quant:
+                pltpu.make_async_copy(
+                    embs_hbm.at[toks_smem[i, 0]], embs_scr.at[i],
+                    sem_embs.at[i],
+                ).start()
             return 0
 
         jax.lax.fori_loop(0, rt, issue, 0)
 
         h = h_scr[:]
         if not static_ctx:
-            # Attention step (query = previous hidden state).
+            # Attention step (query = previous hidden state).  Under
+            # int8w the query GEMM consumes int8 codes and applies the
+            # per-channel scale AFTER the f32 accumulation — the
+            # ``quant_matmul`` contract (ops/quant.py).
             q = jax.lax.dot_general(
-                h.astype(cdt), awh_ref[:],
+                h.astype(cdt), awh_ref[:].astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quant:
+                q = q * as_ref[:]
             th = jnp.tanh(proj_ref[:] + q.astype(cdt)[:, None, :])
             vvec = av_ref[:].astype(jnp.float32)[:, 0]
             s = jnp.sum(
@@ -296,29 +338,56 @@ def _make_beam_kernel(btv: int, K: int, Kt: int, Vt: int, T: int, V: int,
             pltpu.make_async_copy(
                 emb_hbm.at[toks_smem[i, 0]], emb_scr.at[i], sem_emb.at[i]
             ).wait()
+            if quant:
+                pltpu.make_async_copy(
+                    embs_hbm.at[toks_smem[i, 0]], embs_scr.at[i],
+                    sem_embs.at[i],
+                ).wait()
             return 0
 
         jax.lax.fori_loop(0, rt, wait, 0)
 
+        if quant:
+            # Row dequant mirrors ops/quant.py::dequant_rows: one f32
+            # multiply, ONE rounding into compute dtype.
+            x_emb = (
+                emb_scr[:].astype(jnp.float32) * embs_scr[:]
+            ).astype(cdt)
+        else:
+            x_emb = emb_scr[:]
+
         # Summation order matters for twin parity (float adds don't
         # reassociate): gxs + emb [+ ctx] + wh, ctx omitted in the
-        # static variant — the sampler kernel's exact order.
-        gates = gxs_ref[:].astype(jnp.float32) + jax.lax.dot_general(
-            emb_scr[:], wx_ref[:],
+        # static variant — the sampler kernel's exact order.  Under
+        # int8w each per-operand GEMM applies the shared (4H,) lstm
+        # column scale after its own f32 accumulation (the scale
+        # distributes over the row-split sum, matching ``lstm_step``'s
+        # single fused quant GEMM).
+        gx_emb = jax.lax.dot_general(
+            x_emb, wx_ref[:].astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant:
+            gx_emb = gx_emb * ls_ref[:]
+        gates = gxs_ref[:].astype(jnp.float32) + gx_emb
         if not static_ctx:
-            gates = gates + jax.lax.dot_general(
-                ctx.astype(cdt), wctx_ref[:],
+            gx_ctx = jax.lax.dot_general(
+                ctx.astype(cdt), wctx_ref[:].astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        gates = gates + jax.lax.dot_general(
-            h.astype(cdt), wh_ref[:],
+            if quant:
+                gx_ctx = gx_ctx * ls_ref[:]
+            gates = gates + gx_ctx
+        gx_h = jax.lax.dot_general(
+            h.astype(cdt), wh_ref[:].astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant:
+            gx_h = gx_h * ls_ref[:]
+        gates = gates + gx_h
         h_new, c_new = _gate_update(gates, c_scr[:])
 
         # Vocab logits streamed in V-tiles; online per-row top-K + LSE.
@@ -341,17 +410,34 @@ def _make_beam_kernel(btv: int, K: int, Kt: int, Vt: int, T: int, V: int,
                 wcopy(k + 1, jax.lax.rem(k + 1, 2)).start()
 
             wcopy(k, slot).wait()
-            # Match CaptionModel._logits numerics exactly: the vocab dot
-            # and bias add round through compute dtype BEFORE the f32
-            # cast, so top-K ties break identically to the scan path.
-            logit = (
-                jax.lax.dot_general(
-                    hq, wout_scr[slot],
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ).astype(cdt)
-                + bout_ref[:, pl.ds(k * Vt, Vt)].astype(cdt)
-            ).astype(jnp.float32)
+            if quant:
+                # Match the unfused int8w ``_logits`` numerics exactly:
+                # f32-pinned accumulation over int8 codes, per-channel
+                # scale AFTER the accumulation, f32 bias add, and NO
+                # round through compute dtype (``quant_matmul`` never
+                # rounds its f32 product back down).
+                logit = (
+                    jax.lax.dot_general(
+                        hq, wout_scr[slot].astype(cdt),
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * ws_ref[:, pl.ds(k * Vt, Vt)]
+                    + bout_ref[:, pl.ds(k * Vt, Vt)]
+                )
+            else:
+                # Match CaptionModel._logits numerics exactly: the vocab
+                # dot and bias add round through compute dtype BEFORE
+                # the f32 cast, so top-K ties break identically to the
+                # scan path.
+                logit = (
+                    jax.lax.dot_general(
+                        hq, wout_scr[slot],
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ).astype(cdt)
+                    + bout_ref[:, pl.ds(k * Vt, Vt)].astype(cdt)
+                ).astype(jnp.float32)
             mk = jnp.maximum(m, jnp.max(logit, axis=-1, keepdims=True))
             ssum = ssum * jnp.exp(m - mk) + jnp.sum(
                 jnp.exp(logit - mk), axis=-1, keepdims=True
@@ -422,10 +508,14 @@ def _make_beam_kernel(btv: int, K: int, Kt: int, Vt: int, T: int, V: int,
 # ------------------------------------------------------------ public entry
 
 def _beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
-               beam_size, max_len, suppress_unk):
+               beam_size, max_len, suppress_unk,
+               quant=None, compute_dtype=None):
     """Shared pallas_call plumbing for both fusion modes.  ``att`` is
     ``(w_ctx, att_wh, att_v, att_proj, att_mask, att_vals)`` (per-VIDEO
-    tensors) or None for the static-context (meanpool) variant."""
+    tensors) or None for the static-context (meanpool) variant.
+    ``quant`` is ``(emb_scale, wout_scale, lstm_scale, att_scale)``
+    (att_scale None in static-context mode) when the weight operands
+    carry int8 codes; ``compute_dtype`` names the activation dtype."""
     static_ctx = att is None
     K = beam_size
     B = gx_static.shape[0]
@@ -436,8 +526,12 @@ def _beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
     else:
         F, A = att[3].shape[1], att[3].shape[2]
     V = emb.shape[0]
-    cdt = wh.dtype
+    cdt = jnp.dtype(compute_dtype) if quant is not None else wh.dtype
     T = max_len
+    # Tile geometry stays on the ACTIVATION itemsize under int8w too —
+    # same (btv, Vt) as the float path, so the LSE chunk order and tie
+    # behavior carry over; the int8 double buffer streams the same tile
+    # at 0.25x the bytes (docs/PERF.md r17).
     btv, Vt = _pick_tiles(B, K, F, A, E, H, T, jnp.dtype(cdt).itemsize)
     rt = btv * K
     V_pad = -(-V // Vt) * Vt
@@ -446,7 +540,15 @@ def _beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
     # Decode-policy mask + vocab padding folded into the bias (shared
     # with the sampler): masked/padded positions never enter the top-K
     # (they lose every NEG_INF tie to lower vocab ids) and add 0 to LSE.
-    bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
+    if quant is None:
+        bias, w_out_p = _masked_vocab(
+            b_out, w_out, V, V_pad, suppress_unk, cdt
+        )
+    else:
+        emb_scale, wout_scale, lstm_scale, att_scale = quant
+        bias, w_out_p, ws_p = _masked_vocab_q(
+            b_out, w_out, wout_scale, V, V_pad, suppress_unk
+        )
 
     # Flatten the (B, K) beam grid to R = B*K video-major rows, exactly
     # like the scan path's jnp.repeat expansion of state and cache.
@@ -467,26 +569,42 @@ def _beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
         att_specs = [
             const2(E, 4 * H),                           # w_ctx
             const2(H, A),                               # att_wh
+            *([const2(1, A)] if quant is not None else []),  # att scale
             const2(A, 1),                               # att_v
             per_r(F, A),                                # att_proj
             per_r(F),                                   # att_mask
             per_r(F, E),                                # att_vals
         ]
         att_args = [
-            w_ctx, att_wh, att_v, rep(att_proj),
+            w_ctx, att_wh,
+            *([att_scale.astype(jnp.float32)[None, :]]
+              if quant is not None else []),
+            att_v, rep(att_proj),
             rep(att_mask.astype(jnp.float32)), rep(att_vals),
         ]
+    q_mid_specs, q_mid_args = [], []
+    q_tail_specs, q_tail_args = [], []
+    wdt = cdt if quant is None else jnp.int8
+    if quant is not None:
+        q_mid_specs = [const2(1, 4 * H)]                # lstm scale
+        q_mid_args = [lstm_scale.astype(jnp.float32)[None, :]]
+        q_tail_specs = [const2(1, V_pad)]               # w_out scale
+        q_tail_args = [ws_p[None, :]]
     seqs, scores = pl.pallas_call(
-        _make_beam_kernel(btv, K, Kt, Vt, T, V, V_pad,
-                          static_ctx=static_ctx),
+        _make_beam_kernel(btv, K, Kt, Vt, T, V, V_pad, cdt,
+                          static_ctx=static_ctx, quant=quant is not None),
         grid=grid,
         in_specs=[
             per_r(4 * H),                               # gx_static
             const2(E, 4 * H),                           # w_x
             const2(H, 4 * H),                           # wh
+            *q_mid_specs,
             *att_specs,
             const2(1, V_pad),                           # bias
+            *q_tail_specs,
             pl.BlockSpec(memory_space=pl.ANY),          # emb (HBM)
+            *([pl.BlockSpec(memory_space=pl.ANY)]       # emb scale (HBM)
+              if quant is not None else []),
             pl.BlockSpec(memory_space=pl.ANY),          # w_out (HBM)
         ],
         out_specs=[per_r(T), per_r(1)],
@@ -502,27 +620,37 @@ def _beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
             pltpu.VMEM((rt, T), jnp.int32),         # hypothesis buffer
             pltpu.VMEM((rt, 1), jnp.int32),         # feed tokens (VMEM)
             pltpu.SMEM((rt, 1), jnp.int32),         # feed tokens (SMEM)
-            pltpu.VMEM((rt, E), cdt),               # gathered emb rows
-            pltpu.VMEM((2, H, Vt), cdt),            # w_out double buffer
+            pltpu.VMEM((rt, E), wdt),               # gathered emb rows
+            *([pltpu.VMEM((rt, 1), jnp.float32)]    # gathered emb scales
+              if quant is not None else []),
+            pltpu.VMEM((2, H, Vt), wdt),            # w_out double buffer
             pltpu.SemaphoreType.DMA((rt,)),
+            *([pltpu.SemaphoreType.DMA((rt,))]
+              if quant is not None else []),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=_interpret(),
     )(
-        gx_r, w_x, wh, *att_args,
-        bias[None, :], emb, w_out_p,
+        gx_r, w_x, wh, *q_mid_args, *att_args,
+        bias[None, :], *q_tail_args, emb,
+        *([emb_scale.astype(jnp.float32)[:, None]]
+          if quant is not None else []),
+        w_out_p,
     )
     return seqs.reshape(B, K, T), scores.reshape(B, K)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("beam_size", "max_len", "suppress_unk")
+    jax.jit,
+    static_argnames=("beam_size", "max_len", "suppress_unk",
+                     "compute_dtype"),
 )
 def attlstm_beam(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
     att_vals, emb, w_out, b_out,
     *, beam_size: int, max_len: int, suppress_unk: bool = False,
+    quant=None, compute_dtype=None,
 ):
     """Fused beam search from zero state (attention fusion).
 
@@ -536,27 +664,42 @@ def attlstm_beam(
     Returns ``(seqs (B, K, max_len) int32, scores (B, K) float32)`` —
     the raw (unnormalized, unsorted) beam state the scan path's scan
     emits; feed both to ``decoding.beam.finalize_beams``.
+
+    Int8w mode: pass ``quant=(emb_scale, wout_scale, lstm_scale,
+    att_scale)`` with ``emb``/``w_out``/``w_x``/``wh``/``w_ctx``/
+    ``att_wh`` as int8 codes and ``compute_dtype`` naming the activation
+    dtype — the kernel streams the int8 vocab tiles (0.25x the f32
+    bytes) and dequantizes in-kernel with ``quant_matmul`` semantics.
     """
     return _beam_impl(
         gx_static, w_x, wh,
         (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
         emb, w_out, b_out, beam_size, max_len, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
 @functools.partial(
-    jax.jit, static_argnames=("beam_size", "max_len", "suppress_unk")
+    jax.jit,
+    static_argnames=("beam_size", "max_len", "suppress_unk",
+                     "compute_dtype"),
 )
 def lstm_beam(
     gx_static, w_x, wh, emb, w_out, b_out,
     *, beam_size: int, max_len: int, suppress_unk: bool = False,
+    quant=None, compute_dtype=None,
 ):
     """Static-context (meanpool-fusion) fused beam search: the per-video
     context and category gate contributions are already folded into
-    ``gx_static``.  Same return contract as :func:`attlstm_beam`."""
+    ``gx_static``.  Same return contract — and int8w contract
+    (``quant=(emb_scale, wout_scale, lstm_scale)``) — as
+    :func:`attlstm_beam`."""
+    if quant is not None and len(quant) == 3:
+        quant = (*quant, None)
     return _beam_impl(
         gx_static, w_x, wh, None, emb, w_out, b_out,
         beam_size, max_len, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
@@ -564,12 +707,16 @@ def lstm_beam(
 
 def lstm_beam_scan(gx_static, w_x, wh, emb, w_out, b_out,
                    *, beam_size: int, max_len: int,
-                   suppress_unk: bool = False):
+                   suppress_unk: bool = False, quant=None,
+                   compute_dtype=None):
     """Pure-XLA twin of :func:`lstm_beam` (static-context variant)."""
+    if quant is not None and len(quant) == 3:
+        quant = (*quant, None)
     return attlstm_beam_scan(
         gx_static, w_x, wh, None, None, None, None, None, None,
         emb, w_out, b_out,
         beam_size=beam_size, max_len=max_len, suppress_unk=suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
@@ -577,17 +724,21 @@ def attlstm_beam_scan(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
     att_vals, emb, w_out, b_out,
     *, beam_size: int, max_len: int, suppress_unk: bool = False,
+    quant=None, compute_dtype=None,
 ):
     """Bit-comparable XLA reference of the kernel: same decomposed GEMM
     order, same V-tile-chunked log-sum-exp accumulation (via the same
     ``_pick_tiles``), and the SAME ``_row_topk``/``_select_beams``
     helpers — tokens AND scores match the kernel exactly at any compute
-    dtype.  ``att_proj is None`` selects the static-context variant."""
+    dtype.  ``att_proj is None`` selects the static-context variant.
+    ``quant`` mirrors :func:`attlstm_beam`'s int8w contract op-for-op:
+    same dequant placement (scale after the f32-pinned accumulation),
+    same single-rounding row dequant, same tile picker."""
     static_ctx = att_proj is None
     K = beam_size
     B = gx_static.shape[0]
     V = emb.shape[0]
-    cdt = wh.dtype
+    cdt = jnp.dtype(compute_dtype) if quant is not None else wh.dtype
     E = w_x.shape[0]
     H = wh.shape[0]
     if static_ctx:
@@ -598,7 +749,18 @@ def attlstm_beam_scan(
     _, Vt = _pick_tiles(B, K, F, A, E, H, T, jnp.dtype(cdt).itemsize)
     V_pad = -(-V // Vt) * Vt
     Kt = V_pad // Vt
-    bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
+    if quant is None:
+        emb_scale = wout_scale = lstm_scale = att_scale = None
+        bias, w_out_p = _masked_vocab(
+            b_out, w_out, V, V_pad, suppress_unk, cdt
+        )
+    else:
+        emb_scale, wout_scale, lstm_scale, att_scale = quant
+        bias, w_out_p, ws_p = _masked_vocab_q(
+            b_out, w_out, wout_scale, V, V_pad, suppress_unk
+        )
+        lstm_s = lstm_scale.astype(jnp.float32)[None, :]
+        emb_s = emb_scale.astype(jnp.float32)
 
     rep = lambda x: jnp.repeat(x, K, axis=0)  # noqa: E731
     gx_r = rep(gx_static)
@@ -612,17 +774,29 @@ def attlstm_beam_scan(
 
     def step(carry, t):
         h, c, fin, score, seqs, tok = carry
-        gates = gx_r.astype(jnp.float32) + jax.lax.dot_general(
-            emb[tok].astype(cdt), w_x,
+        if quant is None:
+            x = emb[tok].astype(cdt)
+        else:
+            # dequant_rows semantics: one f32 multiply, ONE rounding.
+            x = (
+                emb[tok].astype(jnp.float32) * emb_s[tok][:, None]
+            ).astype(cdt)
+        gx_emb = jax.lax.dot_general(
+            x, w_x.astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant is not None:
+            gx_emb = gx_emb * lstm_s
+        gates = gx_r.astype(jnp.float32) + gx_emb
         if not static_ctx:
             q = jax.lax.dot_general(
-                h.astype(cdt), att_wh,
+                h.astype(cdt), att_wh.astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quant is not None:
+                q = q * att_scale.astype(jnp.float32)[None, :]
             th = jnp.tanh(proj_r + q.astype(cdt)[:, None, :])
             s = jnp.sum(
                 th.astype(jnp.float32) * vvec[None, None, :], axis=-1
@@ -634,28 +808,47 @@ def attlstm_beam_scan(
             ctx = jnp.sum(
                 a[:, :, None] * vals_r.astype(jnp.float32), axis=1
             )
-            gates = gates + jax.lax.dot_general(
-                ctx.astype(cdt), w_ctx,
+            gx_ctx = jax.lax.dot_general(
+                ctx.astype(cdt), w_ctx.astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        gates = gates + jax.lax.dot_general(
-            h.astype(cdt), wh,
+            if quant is not None:
+                gx_ctx = gx_ctx * lstm_s
+            gates = gates + gx_ctx
+        gx_h = jax.lax.dot_general(
+            h.astype(cdt), wh.astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant is not None:
+            gx_h = gx_h * lstm_s
+        gates = gates + gx_h
         h_new, c_new = _gate_update(gates, c)
 
         # Full logits, then the kernel's tile-chunked online reduction
         # (same running-max rescale order, same per-tile top-K merge).
-        logits = (
-            jax.lax.dot_general(
-                h_new.astype(cdt), w_out_p,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ).astype(cdt)
-            + bias[None, :].astype(cdt)
-        ).astype(jnp.float32)
+        if quant is None:
+            logits = (
+                jax.lax.dot_general(
+                    h_new.astype(cdt), w_out_p,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(cdt)
+                + bias[None, :].astype(cdt)
+            ).astype(jnp.float32)
+        else:
+            # quant_matmul semantics: scale after the f32 accumulation,
+            # f32 bias add, no round through compute dtype.
+            logits = (
+                jax.lax.dot_general(
+                    h_new.astype(cdt), w_out_p.astype(cdt),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * ws_p[None, :]
+                + bias[None, :]
+            )
         m = jnp.full((R, 1), NEG_INF, jnp.float32)
         ssum = jnp.zeros((R, 1), jnp.float32)
         top_v = jnp.full((R, K), _F32_MIN, jnp.float32)

@@ -85,21 +85,26 @@ def lstm_recurrence_scan(gx: jax.Array, wh: jax.Array, with_cell: bool = False):
 
 # -------------------------------------------------------------- pallas path
 
-def _make_kernel(with_cell: bool):
+def _make_kernel(with_cell: bool, quant: bool = False):
     def kernel(gx_ref, wh_ref, *refs):
         """One (batch_tile, time_chunk) grid step.
 
         gx_ref   (Tc, Bt, 4H) VMEM — input gates for this chunk
-        wh_ref   (H, 4H)      VMEM — recurrent kernel (same block each step)
+        wh_ref   (H, 4H)      VMEM — recurrent kernel (same block each
+                              step); int8 codes in quant mode
+        ws_ref   (1, 4H) f32  VMEM — per-column scale (quant mode only)
         out_ref  (Tc, Bt, H)  VMEM — hidden outputs
         cell_ref (Tc, Bt, H)  VMEM — f32 cell residual (with_cell only)
         h_scr/c_scr (Bt, H) f32 VMEM scratch — persist across time chunks
         """
+        refs = list(refs)
+        ws_ref = refs.pop(0) if quant else None
         if with_cell:
             out_ref, cell_ref, h_scr, c_scr = refs
         else:
             out_ref, h_scr, c_scr = refs
         t_chunk = pl.program_id(1)
+        cdt = out_ref.dtype
 
         @pl.when(t_chunk == 0)
         def _():
@@ -111,12 +116,17 @@ def _make_kernel(with_cell: bool):
 
         def body(tt, _):
             h = h_scr[:]
+            # In quant mode the per-channel scale applies AFTER the
+            # f32-pinned accumulation over int8 codes — the
+            # ``quant_matmul`` contract (ops/quant.py).
             rec = jax.lax.dot_general(
-                h.astype(wh.dtype),
-                wh,
+                h.astype(cdt),
+                wh.astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quant:
+                rec = rec * ws_ref[:]
             gates = gx_ref[tt].astype(jnp.float32) + rec
             h_new, c_new = _gate_update(gates, c_scr[:])
             h_scr[:] = h_new
@@ -165,11 +175,17 @@ def lstm_recurrence_pallas(
     *,
     with_cell: bool = False,
     interpret: bool = False,
+    wh_scale: jax.Array | None = None,
+    compute_dtype=None,
 ):
     """Pallas forward from zero state.  Returns h_seq (B, T, H), plus the
-    float32 cell sequence when ``with_cell`` (backward residual)."""
+    float32 cell sequence when ``with_cell`` (backward residual).  Pass
+    ``wh_scale`` (4H,) f32 with int8 ``wh`` codes (and ``compute_dtype``
+    naming the activation dtype) for the in-kernel-dequant int8w path."""
+    quant = wh_scale is not None
     B, T, G = gx.shape
     H = wh.shape[0]
+    odt = jnp.dtype(compute_dtype) if quant else wh.dtype
     bt, tc = _pick_tiles(B, T, G, gx.dtype.itemsize)
     grid = (B // bt, T // tc)
     gx_tm = jnp.swapaxes(gx, 0, 1)  # (T, B, 4H) time-major
@@ -177,16 +193,18 @@ def lstm_recurrence_pallas(
         (tc, bt, width), lambda b, t: (t, b, 0), memory_space=pltpu.VMEM
     )
     out_specs = [block(H)]
-    out_shape = [jax.ShapeDtypeStruct((T, B, H), wh.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((T, B, H), odt)]
     if with_cell:
         out_specs.append(block(H))
         out_shape.append(jax.ShapeDtypeStruct((T, B, H), jnp.float32))
     outs = pl.pallas_call(
-        _make_kernel(with_cell),
+        _make_kernel(with_cell, quant=quant),
         grid=grid,
         in_specs=[
             block(G),
             pl.BlockSpec((H, G), lambda b, t: (0, 0), memory_space=pltpu.VMEM),
+            *([pl.BlockSpec((1, G), lambda b, t: (0, 0),
+                            memory_space=pltpu.VMEM)] if quant else []),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -195,7 +213,8 @@ def lstm_recurrence_pallas(
             pltpu.VMEM((bt, H), jnp.float32),
         ],
         interpret=interpret,
-    )(gx_tm, wh)
+    )(gx_tm, wh,
+      *([wh_scale.astype(jnp.float32)[None, :]] if quant else []))
     if with_cell:
         return jnp.swapaxes(outs[0], 0, 1), jnp.swapaxes(outs[1], 0, 1)
     return jnp.swapaxes(outs[0], 0, 1)
@@ -289,6 +308,51 @@ def _interpret() -> bool:
     # platform also reports "tpu"); anything else (cpu tests, gpu) runs
     # the kernel in interpret mode rather than failing to lower.
     return jax.default_backend() != "tpu"
+
+
+def lstm_recurrence_scan_quant(gx, wh_q, wh_scale, compute_dtype):
+    """Chunk-faithful XLA twin of the quant kernel path: f32-pinned
+    accumulation over int8 codes, per-column scale AFTER the
+    accumulation (``quant_matmul`` semantics); the carried (h, c) stays
+    f32 like the kernel's scratch, and only the emitted h_seq rounds to
+    the activation dtype (the kernel's out write)."""
+    cdt = jnp.dtype(compute_dtype)
+    B = gx.shape[0]
+    H = wh_q.shape[0]
+    ws = wh_scale.astype(jnp.float32)[None, :]
+
+    def step(carry, g_t):
+        h, c = carry
+        rec = jax.lax.dot_general(
+            h.astype(cdt), wh_q.astype(cdt),
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * ws
+        gates = g_t + rec
+        h_new, c_new = _gate_update(gates, c)
+        return (h_new, c_new), h_new
+
+    zeros = jnp.zeros((B, H), jnp.float32)
+    _, h_seq = jax.lax.scan(
+        step, (zeros, zeros), jnp.swapaxes(gx, 0, 1).astype(jnp.float32)
+    )
+    return jnp.swapaxes(h_seq, 0, 1).astype(cdt)
+
+
+def lstm_recurrence_quant(
+    gx, wh_q, wh_scale, compute_dtype, use_pallas: bool = False
+):
+    """Forward-only int8w recurrence: ``wh_q`` (H, 4H) int8 codes,
+    ``wh_scale`` (4H,) f32 per-column scale, dequantized in-kernel with
+    ``quant_matmul`` semantics.  No custom VJP on purpose — quantized
+    weights serve, they never train.  Returns h_seq (B, T, H) in
+    ``compute_dtype``."""
+    if _use_kernel(gx, use_pallas):
+        return lstm_recurrence_pallas(
+            gx, wh_q, interpret=_interpret(),
+            wh_scale=wh_scale, compute_dtype=compute_dtype,
+        )
+    return lstm_recurrence_scan_quant(gx, wh_q, wh_scale, compute_dtype)
 
 
 def _fwd(gx, wh, use_pallas):

@@ -162,6 +162,17 @@ def sampler_shapes_ok(B: int, H: int, A: int, E: int, F: int,
     return _resident_bytes(8, F, A, E, H, 128, itemsize) <= _VMEM_BUDGET
 
 
+def _decode_bias(b_out, V: int, V_pad: int, suppress_unk: bool):
+    """Decode-policy bias (PAD/BOS, optional UNK -> -1e30) padded to the
+    V-tile multiple — shared by the float and int8 vocab paddings."""
+    bias = jnp.full((V_pad,), NEG_INF, jnp.float32)
+    bias = bias.at[:V].set(b_out.astype(jnp.float32))
+    bias = bias.at[PAD_ID].set(NEG_INF).at[BOS_ID].set(NEG_INF)
+    if suppress_unk:
+        bias = bias.at[UNK_ID].set(NEG_INF)
+    return bias
+
+
 def _masked_vocab(b_out, w_out, V: int, V_pad: int, suppress_unk: bool,
                   cdt):
     """Shared bias/weight padding for kernel AND reference: decode-policy
@@ -169,34 +180,68 @@ def _masked_vocab(b_out, w_out, V: int, V_pad: int, suppress_unk: bool,
     ``CaptionModel.mask_decode_logits``) plus the vocab padding to a
     V-tile multiple.  ONE implementation on purpose — the exact-parity
     tests assume both sides build identical logits."""
-    bias = jnp.full((V_pad,), NEG_INF, jnp.float32)
-    bias = bias.at[:V].set(b_out.astype(jnp.float32))
-    bias = bias.at[PAD_ID].set(NEG_INF).at[BOS_ID].set(NEG_INF)
-    if suppress_unk:
-        bias = bias.at[UNK_ID].set(NEG_INF)
+    bias = _decode_bias(b_out, V, V_pad, suppress_unk)
     w_out_p = jnp.zeros((w_out.shape[0], V_pad), cdt).at[:, :V].set(w_out)
     return bias, w_out_p
+
+
+def _masked_vocab_q(b_out, w_out_q, w_scale, V: int, V_pad: int,
+                    suppress_unk: bool):
+    """Int8 twin of :func:`_masked_vocab`: zero int8 codes and unit
+    scales in the padded tail (0 * scale + NEG_INF bias keeps padded
+    columns inert in max and LSE, exactly like the float padding)."""
+    bias = _decode_bias(b_out, V, V_pad, suppress_unk)
+    w_out_p = (
+        jnp.zeros((w_out_q.shape[0], V_pad), jnp.int8).at[:, :V]
+        .set(w_out_q)
+    )
+    ws_p = (
+        jnp.ones((V_pad,), jnp.float32).at[:V]
+        .set(w_scale.astype(jnp.float32))
+    )
+    return bias, w_out_p, ws_p
 
 
 # ----------------------------------------------------------------- kernel
 
 def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
-                        greedy: bool, static_ctx: bool = False):
+                        greedy: bool, cdt, static_ctx: bool = False,
+                        quant: bool = False):
     def kernel(seed_ref, it_ref, gxs_ref, wx_ref, wh_ref, *rest):
+        # Positional unpack shared by all four variants (attention/
+        # static-context x float/int8w); the quant refs interleave with
+        # the weights they rescale so the spec list reads in order.
+        rest = list(rest)
+        ls_ref = rest.pop(0) if quant else None     # lstm scale (1, 4H)
         if static_ctx:
             # Meanpool fusion: the (static) context's gate contribution
             # is folded into gx_static outside — no attention refs.
-            (bout_ref, emb_hbm, wout_hbm, tok_out, lp_out, msk_out,
-             h_scr, c_scr, fin_scr, tokv_scr, toks_smem, emb_scr,
-             wout_scr, sem_emb, sem_w, sem_tok) = rest
+            wctx_ref = awh_ref = as_ref = av_ref = None
+            proj_ref = mask_ref = vals_ref = None
         else:
-            (wctx_ref, awh_ref, av_ref, proj_ref, mask_ref, vals_ref,
-             bout_ref, emb_hbm, wout_hbm, tok_out, lp_out, msk_out,
-             h_scr, c_scr, fin_scr, tokv_scr, toks_smem, emb_scr,
-             wout_scr, sem_emb, sem_w, sem_tok) = rest
+            wctx_ref = rest.pop(0)
+            awh_ref = rest.pop(0)
+            as_ref = rest.pop(0) if quant else None  # att scale (1, A)
+            av_ref = rest.pop(0)
+            proj_ref = rest.pop(0)
+            mask_ref = rest.pop(0)
+            vals_ref = rest.pop(0)
+        bout_ref = rest.pop(0)
+        ws_ref = rest.pop(0) if quant else None     # w_out scale (1, V_pad)
+        emb_hbm = rest.pop(0)
+        embs_hbm = rest.pop(0) if quant else None   # emb scale (V, 1) HBM
+        wout_hbm = rest.pop(0)
+        tok_out, lp_out, msk_out = rest[0], rest[1], rest[2]
+        rest = rest[3:]
+        h_scr, c_scr, fin_scr, tokv_scr, toks_smem, emb_scr = rest[:6]
+        rest = rest[6:]
+        embs_scr = rest.pop(0) if quant else None   # gathered emb scales
+        wout_scr = rest.pop(0)
+        sem_emb = rest.pop(0)
+        sem_embs = rest.pop(0) if quant else None
+        sem_w, sem_tok = rest[0], rest[1]
         b = pl.program_id(0)
         t = pl.program_id(1)
-        cdt = wh_ref.dtype
 
         @pl.when(t == 0)
         def _():
@@ -215,18 +260,28 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
             pltpu.make_async_copy(
                 emb_hbm.at[toks_smem[i, 0]], emb_scr.at[i], sem_emb.at[i]
             ).start()
+            if quant:
+                pltpu.make_async_copy(
+                    embs_hbm.at[toks_smem[i, 0]], embs_scr.at[i],
+                    sem_embs.at[i],
+                ).start()
             return 0
 
         jax.lax.fori_loop(0, bt, issue, 0)
 
         h = h_scr[:]
         if not static_ctx:
-            # Attention step (query = previous hidden state).
+            # Attention step (query = previous hidden state).  Under
+            # int8w the query GEMM consumes int8 codes and applies the
+            # per-channel scale AFTER the f32 accumulation — the
+            # ``quant_matmul`` contract (ops/quant.py).
             q = jax.lax.dot_general(
-                h.astype(cdt), awh_ref[:],
+                h.astype(cdt), awh_ref[:].astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quant:
+                q = q * as_ref[:]
             th = jnp.tanh(proj_ref[:] + q.astype(cdt)[:, None, :])
             vvec = av_ref[:].astype(jnp.float32)[:, 0]
             s = jnp.sum(
@@ -244,29 +299,55 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
             pltpu.make_async_copy(
                 emb_hbm.at[toks_smem[i, 0]], emb_scr.at[i], sem_emb.at[i]
             ).wait()
+            if quant:
+                pltpu.make_async_copy(
+                    embs_hbm.at[toks_smem[i, 0]], embs_scr.at[i],
+                    sem_embs.at[i],
+                ).wait()
             return 0
 
         jax.lax.fori_loop(0, bt, wait, 0)
 
+        if quant:
+            # Row dequant mirrors ops/quant.py::dequant_rows: one f32
+            # multiply, ONE rounding into compute dtype.
+            x_emb = (
+                emb_scr[:].astype(jnp.float32) * embs_scr[:]
+            ).astype(cdt)
+        else:
+            x_emb = emb_scr[:]
+
         # Summation order matters for exact reference parity (float adds
         # don't reassociate): gxs + emb [+ ctx] + wh, ctx omitted in the
-        # static variant.
-        gates = gxs_ref[:].astype(jnp.float32) + jax.lax.dot_general(
-            emb_scr[:], wx_ref[:],
+        # static variant.  Under int8w each per-operand GEMM applies the
+        # shared (4H,) lstm column scale after its own f32 accumulation;
+        # the scale distributes over the row-split sum, so the gate total
+        # matches ``lstm_step``'s single fused quant GEMM semantics.
+        gx_emb = jax.lax.dot_general(
+            x_emb, wx_ref[:].astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant:
+            gx_emb = gx_emb * ls_ref[:]
+        gates = gxs_ref[:].astype(jnp.float32) + gx_emb
         if not static_ctx:
-            gates = gates + jax.lax.dot_general(
-                ctx.astype(cdt), wctx_ref[:],
+            gx_ctx = jax.lax.dot_general(
+                ctx.astype(cdt), wctx_ref[:].astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        gates = gates + jax.lax.dot_general(
-            h.astype(cdt), wh_ref[:],
+            if quant:
+                gx_ctx = gx_ctx * ls_ref[:]
+            gates = gates + gx_ctx
+        gx_h = jax.lax.dot_general(
+            h.astype(cdt), wh_ref[:].astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant:
+            gx_h = gx_h * ls_ref[:]
+        gates = gates + gx_h
         h_new, c_new = _gate_update(gates, c_scr[:])
         h_scr[:] = h_new
         c_scr[:] = c_new
@@ -303,18 +384,34 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
                 wcopy(k + 1, jax.lax.rem(k + 1, 2)).start()
 
             wcopy(k, slot).wait()
-            # Match CaptionModel._logits numerics exactly: the vocab dot
-            # and bias add round through compute dtype BEFORE the f32
-            # cast (the scan path computes h@W + b in bf16), so greedy
-            # argmax ties break identically.
-            logit = (
-                jax.lax.dot_general(
-                    hq, wout_scr[slot],
-                    dimension_numbers=(((1,), (0,)), ((), ())),
-                    preferred_element_type=jnp.float32,
-                ).astype(cdt)
-                + bout_ref[:, pl.ds(k * Vt, Vt)].astype(cdt)
-            ).astype(jnp.float32)
+            if quant:
+                # Match the unfused int8w ``_logits`` numerics exactly:
+                # f32-pinned accumulation over int8 codes, per-channel
+                # scale AFTER the accumulation, f32 bias add, and NO
+                # round through compute dtype (``quant_matmul`` never
+                # rounds its f32 product back down).
+                logit = (
+                    jax.lax.dot_general(
+                        hq, wout_scr[slot].astype(cdt),
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    )
+                    * ws_ref[:, pl.ds(k * Vt, Vt)]
+                    + bout_ref[:, pl.ds(k * Vt, Vt)]
+                )
+            else:
+                # Match CaptionModel._logits numerics exactly: the vocab
+                # dot and bias add round through compute dtype BEFORE
+                # the f32 cast (the scan path computes h@W + b in bf16),
+                # so greedy argmax ties break identically.
+                logit = (
+                    jax.lax.dot_general(
+                        hq, wout_scr[slot],
+                        dimension_numbers=(((1,), (0,)), ((), ())),
+                        preferred_element_type=jnp.float32,
+                    ).astype(cdt)
+                    + bout_ref[:, pl.ds(k * Vt, Vt)].astype(cdt)
+                ).astype(jnp.float32)
             scaled = logit * inv_temp
             if greedy:
                 z = scaled
@@ -380,10 +477,16 @@ def _make_sample_kernel(bt: int, Vt: int, K: int, T: int, V_pad: int,
 # ------------------------------------------------------------ public entry
 
 def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
-                 max_len, greedy, temperature, suppress_unk):
+                 max_len, greedy, temperature, suppress_unk,
+                 quant=None, compute_dtype=None):
     """Shared pallas_call plumbing for both fusion modes.  ``att`` is
     ``(w_ctx, att_wh, att_v, att_proj, att_mask, att_vals)`` or None
-    for the static-context (meanpool) variant."""
+    for the static-context (meanpool) variant.  ``quant`` is
+    ``(emb_scale, wout_scale, lstm_scale, att_scale)`` (att_scale None
+    in static-context mode) when the weight operands carry int8 codes
+    — the kernel then dequantizes in-kernel with ``quant_matmul``
+    semantics; ``compute_dtype`` names the activation dtype (the int8
+    codes no longer carry it)."""
     static_ctx = att is None
     B = gx_static.shape[0]
     H = wh.shape[0]
@@ -393,14 +496,26 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
     else:
         F, A = att[3].shape[1], att[3].shape[2]
     V = emb.shape[0]
-    cdt = wh.dtype
+    cdt = jnp.dtype(compute_dtype) if quant is not None else wh.dtype
+    # Tile geometry is picked on the ACTIVATION itemsize either way so
+    # the int8w stream keeps the float path's (bt, Vt) — same Gumbel
+    # counters, same LSE chunk order; the int8 double buffer then holds
+    # the same tile at 0.25x the bytes (docs/PERF.md r17).
     bt, Vt = _pick_tiles(B, F, A, E, H, jnp.dtype(cdt).itemsize)
     V_pad = -(-V // Vt) * Vt
     K = V_pad // Vt
 
     # Decode-policy mask + vocab padding folded into the bias (see
     # module doc): masked/padded positions never win and add 0 to LSE.
-    bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
+    if quant is None:
+        bias, w_out_p = _masked_vocab(
+            b_out, w_out, V, V_pad, suppress_unk, cdt
+        )
+    else:
+        emb_scale, wout_scale, lstm_scale, att_scale = quant
+        bias, w_out_p, ws_p = _masked_vocab_q(
+            b_out, w_out, wout_scale, V, V_pad, suppress_unk
+        )
 
     # Two 32-bit seed words (ADVICE r5 #2); a legacy scalar seed pads
     # word 1 with zero.  Kept traced — no recompile per seed.
@@ -438,6 +553,7 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
         att_specs = [
             const2(E, 4 * H),                           # w_ctx
             const2(H, A),                               # att_wh
+            *([const2(1, A)] if quant is not None else []),  # att scale
             const2(A, 1),                               # att_v
             per_b(F, A),                                # att_proj
             pl.BlockSpec((bt, F), lambda b, t: (b, 0),
@@ -445,12 +561,25 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
             per_b(F, E),                                # att_vals
         ]
         att_args = [
-            w_ctx, att_wh, att_v, att_proj,
+            w_ctx, att_wh,
+            *([att_scale.astype(jnp.float32)[None, :]]
+              if quant is not None else []),
+            att_v, att_proj,
             att_mask.astype(jnp.float32), att_vals,
         ]
+    q_mid_specs, q_mid_args = [], []
+    q_tail_specs, q_tail_args = [], []
+    q_scratch = []
+    wdt = cdt if quant is None else jnp.int8
+    if quant is not None:
+        q_mid_specs = [const2(1, 4 * H)]                # lstm scale
+        q_mid_args = [lstm_scale.astype(jnp.float32)[None, :]]
+        q_tail_specs = [const2(1, V_pad)]               # w_out scale
+        q_tail_args = [ws_p[None, :]]
     toks, lps, msk = pl.pallas_call(
         _make_sample_kernel(
-            bt, Vt, K, T, V_pad, bool(greedy), static_ctx=static_ctx,
+            bt, Vt, K, T, V_pad, bool(greedy), cdt,
+            static_ctx=static_ctx, quant=quant is not None,
         ),
         grid=grid,
         in_specs=[
@@ -460,9 +589,13 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
                          memory_space=pltpu.VMEM),      # gx_static
             const2(E, 4 * H),                           # w_x
             const2(H, 4 * H),                           # wh
+            *q_mid_specs,
             *att_specs,
             const2(1, V_pad),                           # bias
+            *q_tail_specs,
             pl.BlockSpec(memory_space=pl.ANY),          # emb (HBM)
+            *([pl.BlockSpec(memory_space=pl.ANY)]       # emb scale (HBM)
+              if quant is not None else []),
             pl.BlockSpec(memory_space=pl.ANY),          # w_out (HBM)
         ],
         out_specs=[tm(), tm(), tm()],
@@ -477,17 +610,24 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
             pltpu.VMEM((bt, 1), jnp.float32),       # finished
             pltpu.VMEM((bt, 1), jnp.int32),         # feed tokens (VMEM)
             pltpu.SMEM((bt, 1), jnp.int32),         # feed tokens (SMEM)
-            pltpu.VMEM((bt, E), cdt),               # gathered emb rows
-            pltpu.VMEM((2, H, Vt), cdt),            # w_out double buffer
+            pltpu.VMEM((bt, E), wdt),               # gathered emb rows
+            *([pltpu.VMEM((bt, 1), jnp.float32)]    # gathered emb scales
+              if quant is not None else []),
+            pltpu.VMEM((2, H, Vt), wdt),            # w_out double buffer
             pltpu.SemaphoreType.DMA((bt,)),
+            *([pltpu.SemaphoreType.DMA((bt,))]
+              if quant is not None else []),
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=_interpret(),
     )(
         seed2, inv_temp.reshape((1,)),
-        gx_static, w_x, wh, *att_args,
-        bias[None, :], emb, w_out_p,
+        gx_static, w_x, wh, *q_mid_args, *att_args,
+        bias[None, :], *q_tail_args, emb,
+        *([emb_scale.astype(jnp.float32)[:, None]]
+          if quant is not None else []),
+        w_out_p,
     )
     return (
         jnp.swapaxes(toks, 0, 1),
@@ -498,13 +638,13 @@ def _sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out, seed,
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_len", "greedy", "suppress_unk"),
+    static_argnames=("max_len", "greedy", "suppress_unk", "compute_dtype"),
 )
 def attlstm_sample(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
     att_vals, emb, w_out, b_out, seed,
     *, max_len: int, greedy: bool, temperature: float = 1.0,
-    suppress_unk: bool = False,
+    suppress_unk: bool = False, quant=None, compute_dtype=None,
 ):
     """Fused autoregressive sample from zero state (attention fusion).
 
@@ -519,32 +659,43 @@ def attlstm_sample(
 
     Returns (tokens, logprobs, mask), each (B, max_len), with the exact
     finished-row semantics of ``CaptionModel._sample_from_cache``.
+
+    Int8w mode: pass ``quant=(emb_scale, wout_scale, lstm_scale,
+    att_scale)`` with ``emb``/``w_out``/``w_x``/``wh``/``w_ctx``/
+    ``att_wh`` as int8 codes and ``compute_dtype`` naming the activation
+    dtype — the kernel streams the int8 vocab tiles (0.25x the f32
+    bytes) and dequantizes in-kernel with ``quant_matmul`` semantics.
     """
     return _sample_impl(
         gx_static, w_x, wh,
         (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
         emb, w_out, b_out, seed,
         max_len, greedy, temperature, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_len", "greedy", "suppress_unk"),
+    static_argnames=("max_len", "greedy", "suppress_unk", "compute_dtype"),
 )
 def lstm_sample(
     gx_static, w_x, wh, emb, w_out, b_out, seed,
     *, max_len: int, greedy: bool, temperature: float = 1.0,
-    suppress_unk: bool = False,
+    suppress_unk: bool = False, quant=None, compute_dtype=None,
 ):
     """Static-context (meanpool-fusion) fused sample: the per-row
     context and category gate contributions are already folded into
     ``gx_static``, so each step is gather + two GEMMs + gate update +
-    streamed vocab sampling — no attention block.  Same semantics and
-    return contract as :func:`attlstm_sample`."""
+    streamed vocab sampling — no attention block.  Same semantics,
+    int8w contract (``quant=(emb_scale, wout_scale, lstm_scale)``)
+    and return contract as :func:`attlstm_sample`."""
+    if quant is not None and len(quant) == 3:
+        quant = (*quant, None)
     return _sample_impl(
         gx_static, w_x, wh, None, emb, w_out, b_out, seed,
         max_len, greedy, temperature, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
@@ -553,14 +704,17 @@ def lstm_sample(
 def lstm_sample_scan(
     gx_static, w_x, wh, emb, w_out, b_out, seed,
     *, max_len: int, greedy: bool, temperature: float = 1.0,
-    suppress_unk: bool = False,
+    suppress_unk: bool = False, quant=None, compute_dtype=None,
 ):
     """Pure-XLA twin of :func:`lstm_sample` (static-context variant)."""
+    if quant is not None and len(quant) == 3:
+        quant = (*quant, None)
     return attlstm_sample_scan(
         gx_static, w_x, wh, None, None, None, None, None, None,
         emb, w_out, b_out, seed,
         max_len=max_len, greedy=greedy, temperature=temperature,
-        suppress_unk=suppress_unk,
+        suppress_unk=suppress_unk, quant=quant,
+        compute_dtype=compute_dtype,
     )
 
 
@@ -568,7 +722,7 @@ def attlstm_sample_scan(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
     att_vals, emb, w_out, b_out, seed,
     *, max_len: int, greedy: bool, temperature: float = 1.0,
-    suppress_unk: bool = False,
+    suppress_unk: bool = False, quant=None, compute_dtype=None,
 ):
     """Bit-comparable XLA reference of the kernel, INCLUDING the hash-RNG
     multinomial stream (same counters, same mixer) — the parity tests
@@ -576,10 +730,13 @@ def attlstm_sample_scan(
     ``Vt``-wide chunks; this reference computes the same quantities
     globally, which agrees because max/argmax are tile-order invariant
     and the bias masking is identical.  ``att_proj is None`` selects the
-    static-context variant (use :func:`lstm_sample_scan`)."""
+    static-context variant (use :func:`lstm_sample_scan`).  ``quant``
+    mirrors :func:`attlstm_sample`'s int8w contract op-for-op: same
+    dequant placement (scale after the f32-pinned accumulation), same
+    single-rounding row dequant, same tile picker."""
     B = gx_static.shape[0]
     V = emb.shape[0]
-    cdt = wh.dtype
+    cdt = jnp.dtype(compute_dtype) if quant is not None else wh.dtype
     E = w_x.shape[0]
     if att_proj is None:
         F = A = 0
@@ -591,7 +748,18 @@ def attlstm_sample_scan(
         B, F, A, E, wh.shape[0], jnp.dtype(cdt).itemsize,
     )
     V_pad = -(-V // Vt) * Vt
-    bias, w_out_p = _masked_vocab(b_out, w_out, V, V_pad, suppress_unk, cdt)
+    if quant is None:
+        emb_scale = wout_scale = lstm_scale = att_scale = None
+        bias, w_out_p = _masked_vocab(
+            b_out, w_out, V, V_pad, suppress_unk, cdt
+        )
+    else:
+        emb_scale, wout_scale, lstm_scale, att_scale = quant
+        bias, w_out_p, ws_p = _masked_vocab_q(
+            b_out, w_out, wout_scale, V, V_pad, suppress_unk
+        )
+        lstm_s = lstm_scale.astype(jnp.float32)[None, :]
+        emb_s = emb_scale.astype(jnp.float32)
 
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(-1)
     if seed_arr.shape[0] < 2:
@@ -621,18 +789,32 @@ def attlstm_sample_scan(
 
     def step2(carry, t):
         h, c, fin, tok = carry
-        # Gate sum order mirrors the kernel exactly (see its comment).
-        gates = gx_static.astype(jnp.float32) + jax.lax.dot_general(
-            emb[tok].astype(cdt), w_x,
+        if quant is None:
+            x = emb[tok].astype(cdt)
+        else:
+            # dequant_rows semantics: one f32 multiply, ONE rounding.
+            x = (
+                emb[tok].astype(jnp.float32) * emb_s[tok][:, None]
+            ).astype(cdt)
+        # Gate sum order mirrors the kernel exactly (see its comment);
+        # under int8w each per-operand GEMM applies the shared lstm
+        # column scale after its own f32 accumulation.
+        gx_emb = jax.lax.dot_general(
+            x, w_x.astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant is not None:
+            gx_emb = gx_emb * lstm_s
+        gates = gx_static.astype(jnp.float32) + gx_emb
         if not static_ctx:
             q = jax.lax.dot_general(
-                h.astype(cdt), att_wh,
+                h.astype(cdt), att_wh.astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
+            if quant is not None:
+                q = q * att_scale.astype(jnp.float32)[None, :]
             th = jnp.tanh(att_proj + q.astype(cdt)[:, None, :])
             s = jnp.sum(
                 th.astype(jnp.float32) * vvec[None, None, :], axis=-1
@@ -642,25 +824,44 @@ def attlstm_sample_scan(
             ctx = jnp.sum(
                 a[:, :, None] * att_vals.astype(jnp.float32), axis=1
             )
-            gates = gates + jax.lax.dot_general(
-                ctx.astype(cdt), w_ctx,
+            gx_ctx = jax.lax.dot_general(
+                ctx.astype(cdt), w_ctx.astype(cdt),
                 dimension_numbers=(((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
             )
-        gates = gates + jax.lax.dot_general(
-            h.astype(cdt), wh,
+            if quant is not None:
+                gx_ctx = gx_ctx * lstm_s
+            gates = gates + gx_ctx
+        gx_h = jax.lax.dot_general(
+            h.astype(cdt), wh.astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
+        if quant is not None:
+            gx_h = gx_h * lstm_s
+        gates = gates + gx_h
         h_new, c_new = _gate_update(gates, c)
-        logits = (
-            jax.lax.dot_general(
-                h_new.astype(cdt), w_out_p,
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            ).astype(cdt)
-            + bias[None, :].astype(cdt)
-        ).astype(jnp.float32)
+        if quant is None:
+            logits = (
+                jax.lax.dot_general(
+                    h_new.astype(cdt), w_out_p,
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                ).astype(cdt)
+                + bias[None, :].astype(cdt)
+            ).astype(jnp.float32)
+        else:
+            # quant_matmul semantics: scale after the f32 accumulation,
+            # f32 bias add, no round through compute dtype.
+            logits = (
+                jax.lax.dot_general(
+                    h_new.astype(cdt), w_out_p.astype(cdt),
+                    dimension_numbers=(((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+                * ws_p[None, :]
+                + bias[None, :]
+            )
         scaled = logits * inv_temp
         if greedy:
             z = scaled

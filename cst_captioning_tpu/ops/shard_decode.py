@@ -47,6 +47,16 @@ Scope mirrors the kernels: single-layer attention or meanpool decoders
 from zero state, ``V % model_shards == 0`` and ``V/M >= K``
 (``shard_decode_ok``); ``model_from_config`` gates the flags through
 ``decoding/core.py::DECODE_KERNEL_CAPS``.
+
+int8w composition (``quant=``/``compute_dtype=`` kwargs): each shard's
+vocab tile streams int8 CODES — (H, V/M) int8 ``w_out`` columns plus a
+(V/M,) f32 column-scale slice and (V/M, E) int8 embedding rows plus
+their row-scale slice, i.e. ~0.25x the f32 tile bytes per shard — and
+dequantizes locally with ``quant_matmul`` semantics (scale after the
+f32-pinned accumulation, f32 bias, no compute-dtype rounding).  The
+scale slices shard with their weights' ``parallel/partition.py`` rules
+(``logit_w_scale``/``word_embed_scale`` over the model axis, lstm/att
+scales replicated), so entry needs no resharding here either.
 """
 
 from __future__ import annotations
@@ -70,6 +80,7 @@ from cst_captioning_tpu.ops.pallas_sampler import (
     _fmix32,
     _gumbel_from_counter,
     _masked_vocab,
+    _masked_vocab_q,
     _pick_tiles,
 )
 from cst_captioning_tpu.parallel.mesh import shard_map
@@ -86,25 +97,39 @@ def shard_decode_ok(V: int, model_shards: int, K: int = 1) -> bool:
     )
 
 
-def _emb_psum(emb_loc, tok, col0, axis: str):
+def _emb_psum(emb_loc, tok, col0, axis: str, scale_loc=None, cdt=None):
     """Embedding rows for ``tok`` (R,) under a row-sharded (Vloc, E)
     table: masked local lookup + psum over the model axis.  Exact — the
-    M-1 shards that don't own a row contribute 0.0."""
+    M-1 shards that don't own a row contribute 0.0.  Int8w mode
+    (``scale_loc`` a (Vloc,) f32 row-scale slice) dequantizes ONLY the
+    gathered rows before the mask — ``dequant_rows``'s one f32 multiply
+    + single rounding to compute dtype (ops/quant.py)."""
     Vloc = emb_loc.shape[0]
     local = tok - col0
     valid = (local >= 0) & (local < Vloc)
-    rows = emb_loc[jnp.clip(local, 0, Vloc - 1)]
+    ids = jnp.clip(local, 0, Vloc - 1)
+    rows = emb_loc[ids]
+    if scale_loc is not None:
+        rows = (
+            rows.astype(jnp.float32) * scale_loc[ids][:, None]
+        ).astype(cdt)
     rows = jnp.where(valid[:, None], rows, jnp.zeros_like(rows))
     return jax.lax.psum(rows, axis)
 
 
-def _attention_ctx(h, att_wh, proj_r, mask_r, vvec, vals_r, cdt):
-    """The kernels' per-step Bahdanau attention (same op order)."""
+def _attention_ctx(h, att_wh, proj_r, mask_r, vvec, vals_r, cdt,
+                   att_scale=None):
+    """The kernels' per-step Bahdanau attention (same op order).
+    Int8w mode: ``att_wh`` is int8 codes, cast losslessly into compute
+    dtype, with the (A,) ``att_scale`` applied AFTER the f32-pinned
+    accumulation (quant_matmul semantics)."""
     q = jax.lax.dot_general(
-        h.astype(cdt), att_wh,
+        h.astype(cdt), att_wh.astype(cdt),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if att_scale is not None:
+        q = q * att_scale[None, :]
     th = jnp.tanh(proj_r + q.astype(cdt)[:, None, :])
     s = jnp.sum(th.astype(jnp.float32) * vvec[None, None, :], axis=-1)
     s = jnp.where(mask_r > 0, s, NEG_INF)
@@ -114,57 +139,99 @@ def _attention_ctx(h, att_wh, proj_r, mask_r, vvec, vals_r, cdt):
     return jnp.sum(a[:, :, None] * vals_r.astype(jnp.float32), axis=1)
 
 
-def _gates(gx_r, emb_tok, h, w_x, wh, w_ctx, ctx, cdt):
+def _gates(gx_r, emb_tok, h, w_x, wh, w_ctx, ctx, cdt, ls=None):
     """Gate sum in the kernels' exact association order:
-    gxs + emb [+ ctx] + wh."""
-    gates = gx_r.astype(jnp.float32) + jax.lax.dot_general(
-        emb_tok.astype(cdt), w_x,
+    gxs + emb [+ ctx] + wh.  Int8w mode (``ls`` the (4H,) shared
+    per-gate-channel scale): each operand's f32 accumulation is scaled
+    before the sum — the scale distributes over the row-split dot,
+    matching ``lstm_step``'s single fused quant GEMM."""
+    g_emb = jax.lax.dot_general(
+        emb_tok.astype(cdt), w_x.astype(cdt),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if ls is not None:
+        g_emb = g_emb * ls[None, :]
+    gates = gx_r.astype(jnp.float32) + g_emb
     if ctx is not None:
-        gates = gates + jax.lax.dot_general(
-            ctx.astype(cdt), w_ctx,
+        g_ctx = jax.lax.dot_general(
+            ctx.astype(cdt), w_ctx.astype(cdt),
             dimension_numbers=(((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-    return gates + jax.lax.dot_general(
-        h.astype(cdt), wh,
+        if ls is not None:
+            g_ctx = g_ctx * ls[None, :]
+        gates = gates + g_ctx
+    g_h = jax.lax.dot_general(
+        h.astype(cdt), wh.astype(cdt),
         dimension_numbers=(((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32,
     )
+    if ls is not None:
+        g_h = g_h * ls[None, :]
+    return gates + g_h
 
 
-def _local_logits(h_new, w_out_loc, bias_loc, cdt):
-    """This shard's (R, Vloc) logit tile, rounding through compute
-    dtype before the f32 cast exactly like ``CaptionModel._logits``."""
+def _local_logits(h_new, w_out_loc, bias_loc, cdt, ws_loc=None):
+    """This shard's (R, Vloc) logit tile.  Float mode rounds through
+    compute dtype before the f32 cast exactly like
+    ``CaptionModel._logits``; int8w mode (``ws_loc`` a (Vloc,) f32
+    column-scale slice) scales the f32 accumulator and adds the f32
+    bias with NO compute-dtype rounding — ``quant_matmul`` + f32 bias,
+    the quant ``_logits`` semantics."""
+    acc = jax.lax.dot_general(
+        h_new.astype(cdt), w_out_loc.astype(cdt),
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    if ws_loc is not None:
+        return acc * ws_loc[None, :] + bias_loc[None, :].astype(
+            jnp.float32
+        )
     return (
-        jax.lax.dot_general(
-            h_new.astype(cdt), w_out_loc,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ).astype(cdt)
-        + bias_loc[None, :].astype(cdt)
+        acc.astype(cdt) + bias_loc[None, :].astype(cdt)
     ).astype(jnp.float32)
 
 
 # ------------------------------------------------------------------ beam
 
 def _sharded_beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
-                       mesh, axis, beam_size, max_len, suppress_unk):
+                       mesh, axis, beam_size, max_len, suppress_unk,
+                       quant=None, compute_dtype=None):
     """shard_map body + loop shared by both fusion modes.  ``att`` is
     ``(w_ctx, att_wh, att_v, att_proj, att_mask, att_vals)`` or None
     for the static-context (meanpool) variant — the ``_beam_impl``
-    calling convention."""
+    calling convention (including its int8w ``quant``/``compute_dtype``
+    contract: weights arrive as int8 codes, the per-shard vocab tile
+    streams 0.25x the f32 bytes, and the scale slices shard with their
+    weights' partition specs)."""
     static_ctx = att is None
     K = beam_size
     B = gx_static.shape[0]
     V = emb.shape[0]
     M = mesh.shape[axis]
-    cdt = wh.dtype
+    if quant is not None and len(quant) == 3:
+        quant = (*quant, None)
+    cdt = jnp.dtype(compute_dtype) if quant is not None else wh.dtype
     T = max_len
     R = B * K
-    bias, w_out_p = _masked_vocab(b_out, w_out, V, V, suppress_unk, cdt)
+    if quant is not None:
+        emb_scale, wout_scale, lstm_scale, att_scale = quant
+        bias, w_out_p, ws_p = _masked_vocab_q(
+            b_out, w_out, wout_scale, V, V, suppress_unk
+        )
+        q_args = (
+            lstm_scale.astype(jnp.float32),
+            emb_scale.astype(jnp.float32),
+            ws_p,
+        )
+        q_specs = (P(), P(axis), P(axis))
+        if not static_ctx:
+            q_args += (att_scale.astype(jnp.float32),)
+            q_specs += (P(),)
+    else:
+        bias, w_out_p = _masked_vocab(b_out, w_out, V, V, suppress_unk, cdt)
+        q_args, q_specs = (), ()
 
     rep = lambda x: jnp.repeat(x, K, axis=0)  # noqa: E731
     gx_r = rep(gx_static)
@@ -178,7 +245,16 @@ def _sharded_beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
         )
         att_specs = (P(),) * 6
 
-    def body(gx_r, w_x, wh, bias_loc, emb_loc, w_out_loc, *att_local):
+    def body(gx_r, w_x, wh, bias_loc, emb_loc, w_out_loc, *rest):
+        rest = list(rest)
+        if quant is not None:
+            ls = rest.pop(0)        # (4H,) shared lstm scale, replicated
+            embs_loc = rest.pop(0)  # (Vloc,) emb row-scale slice
+            ws_loc = rest.pop(0)    # (Vloc,) w_out column-scale slice
+            asc = rest.pop(0) if not static_ctx else None
+        else:
+            ls = embs_loc = ws_loc = asc = None
+        att_local = rest
         Vloc = w_out_loc.shape[-1]
         shard = jax.lax.axis_index(axis)
         col0 = shard * Vloc
@@ -186,20 +262,25 @@ def _sharded_beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
 
         def step(carry, t):
             h, c, fin, score, seqs, tok = carry
-            emb_tok = _emb_psum(emb_loc, tok, col0, axis)
+            emb_tok = _emb_psum(
+                emb_loc, tok, col0, axis, scale_loc=embs_loc, cdt=cdt
+            )
             ctx = None
             if not static_ctx:
                 w_ctx, att_wh, vvec, proj_r, mask_r, vals_r = att_local
                 ctx = _attention_ctx(
-                    h, att_wh, proj_r, mask_r, vvec, vals_r, cdt
+                    h, att_wh, proj_r, mask_r, vvec, vals_r, cdt,
+                    att_scale=asc,
                 )
             gates = _gates(
                 gx_r, emb_tok, h, w_x, wh,
-                None if static_ctx else att_local[0], ctx, cdt,
+                None if static_ctx else att_local[0], ctx, cdt, ls=ls,
             )
             h_new, c_new = _gate_update(gates, c)
 
-            logit = _local_logits(h_new, w_out_loc, bias_loc, cdt)
+            logit = _local_logits(
+                h_new, w_out_loc, bias_loc, cdt, ws_loc=ws_loc
+            )
             # Exact global max; normalizer folds per-shard partials
             # through one psum (the PARITY r15 association note).
             m = jax.lax.pmax(
@@ -266,40 +347,44 @@ def _sharded_beam_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
             P(axis),                  # bias columns
             P(axis, None),            # embedding rows
             P(None, axis),            # w_out columns
+            *q_specs,                 # int8w scale slices (see q_args)
             *att_specs,
         ),
         out_specs=(P(), P()),
         check_rep=False,  # outputs replicated by construction (merged)
-    )(gx_r, w_x, wh, bias, emb, w_out_p, *att_args)
+    )(gx_r, w_x, wh, bias, emb, w_out_p, *q_args, *att_args)
 
 
 def sharded_attlstm_beam(
     gx_static, w_x, wh, w_ctx, att_wh, att_v, att_proj, att_mask,
     att_vals, emb, w_out, b_out,
     *, mesh, axis: str = "model", beam_size: int, max_len: int,
-    suppress_unk: bool = False,
+    suppress_unk: bool = False, quant=None, compute_dtype=None,
 ):
     """Sharded fused beam search (attention fusion) — the shard_map
     port of :func:`ops.pallas_beam.attlstm_beam`, same argument and
-    ``(seqs (B, K, L), scores (B, K))`` return contract; feed both to
+    ``(seqs (B, K, L), scores (B, K))`` return contract (including the
+    int8w ``quant``/``compute_dtype`` kwargs); feed both to
     ``decoding.beam.finalize_beams``."""
     return _sharded_beam_impl(
         gx_static, w_x, wh,
         (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
         emb, w_out, b_out, mesh, axis, beam_size, max_len, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
 def sharded_lstm_beam(
     gx_static, w_x, wh, emb, w_out, b_out,
     *, mesh, axis: str = "model", beam_size: int, max_len: int,
-    suppress_unk: bool = False,
+    suppress_unk: bool = False, quant=None, compute_dtype=None,
 ):
     """Static-context (meanpool) sharded fused beam search — the
     shard_map port of :func:`ops.pallas_beam.lstm_beam`."""
     return _sharded_beam_impl(
         gx_static, w_x, wh, None, emb, w_out, b_out,
         mesh, axis, beam_size, max_len, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 
@@ -307,7 +392,7 @@ def sharded_lstm_beam(
 
 def _sharded_sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
                          seed, mesh, axis, max_len, greedy, temperature,
-                         suppress_unk):
+                         suppress_unk, quant=None, compute_dtype=None):
     """Sharded fused sampling: per-shard Gumbel-max (or argmax)
     candidates merged by (z desc, global id asc).  The hash-Gumbel
     counters use GLOBAL vocab positions and the kernel's padded-width
@@ -323,11 +408,31 @@ def _sharded_sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
     else:
         F, A = att[3].shape[1], att[3].shape[2]
     V = emb.shape[0]
-    cdt = wh.dtype
+    if quant is not None and len(quant) == 3:
+        quant = (*quant, None)
+    cdt = jnp.dtype(compute_dtype) if quant is not None else wh.dtype
     T = max_len
+    # Activation itemsize even under int8w: the quant grid geometry (and
+    # with it V_pad and the hash-Gumbel counter stream) matches float.
     bt, Vt = _pick_tiles(B, F, A, E, H, jnp.dtype(cdt).itemsize)
     V_pad = -(-V // Vt) * Vt   # counter arithmetic only — no padding
-    bias, w_out_p = _masked_vocab(b_out, w_out, V, V, suppress_unk, cdt)
+    if quant is not None:
+        emb_scale, wout_scale, lstm_scale, att_scale = quant
+        bias, w_out_p, ws_p = _masked_vocab_q(
+            b_out, w_out, wout_scale, V, V, suppress_unk
+        )
+        q_args = (
+            lstm_scale.astype(jnp.float32),
+            emb_scale.astype(jnp.float32),
+            ws_p,
+        )
+        q_specs = (P(), P(axis), P(axis))
+        if not static_ctx:
+            q_args += (att_scale.astype(jnp.float32),)
+            q_specs += (P(),)
+    else:
+        bias, w_out_p = _masked_vocab(b_out, w_out, V, V, suppress_unk, cdt)
+        q_args, q_specs = (), ()
 
     seed_arr = jnp.asarray(seed, jnp.int32).reshape(-1)
     if seed_arr.shape[0] < 2:
@@ -356,7 +461,16 @@ def _sharded_sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
         att_specs = (P(),) * 6
 
     def body(gx, w_x, wh, bias_loc, emb_loc, w_out_loc, seed_words,
-             inv_temp, *att_local):
+             inv_temp, *rest):
+        rest = list(rest)
+        if quant is not None:
+            ls = rest.pop(0)        # (4H,) shared lstm scale, replicated
+            embs_loc = rest.pop(0)  # (Vloc,) emb row-scale slice
+            ws_loc = rest.pop(0)    # (Vloc,) w_out column-scale slice
+            asc = rest.pop(0) if not static_ctx else None
+        else:
+            ls = embs_loc = ws_loc = asc = None
+        att_local = rest
         Vloc = w_out_loc.shape[-1]
         shard = jax.lax.axis_index(axis)
         col0 = shard * Vloc
@@ -364,20 +478,25 @@ def _sharded_sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
 
         def step(carry, t):
             h, c, fin, tok = carry
-            emb_tok = _emb_psum(emb_loc, tok, col0, axis)
+            emb_tok = _emb_psum(
+                emb_loc, tok, col0, axis, scale_loc=embs_loc, cdt=cdt
+            )
             ctx = None
             if not static_ctx:
                 w_ctx, att_wh, vvec, proj_r, mask_r, vals_r = att_local
                 ctx = _attention_ctx(
-                    h, att_wh, proj_r, mask_r, vvec, vals_r, cdt
+                    h, att_wh, proj_r, mask_r, vvec, vals_r, cdt,
+                    att_scale=asc,
                 )
             gates = _gates(
                 gx, emb_tok, h, w_x, wh,
-                None if static_ctx else att_local[0], ctx, cdt,
+                None if static_ctx else att_local[0], ctx, cdt, ls=ls,
             )
             h_new, c_new = _gate_update(gates, c)
 
-            logit = _local_logits(h_new, w_out_loc, bias_loc, cdt)
+            logit = _local_logits(
+                h_new, w_out_loc, bias_loc, cdt, ws_loc=ws_loc
+            )
             scaled = logit * inv_temp
             if greedy:
                 z = scaled
@@ -446,12 +565,13 @@ def _sharded_sample_impl(gx_static, w_x, wh, att, emb, w_out, b_out,
             P(axis, None),            # embedding rows
             P(None, axis),            # w_out columns
             P(), P(),                 # seed words, inv_temp
+            *q_specs,                 # int8w scale slices (see q_args)
             *att_specs,
         ),
         out_specs=(P(), P(), P()),
         check_rep=False,
     )(gx_static, w_x, wh, bias, emb, w_out_p, seed_words, inv_temp,
-      *att_args)
+      *q_args, *att_args)
 
 
 def sharded_attlstm_sample(
@@ -459,15 +579,18 @@ def sharded_attlstm_sample(
     att_vals, emb, w_out, b_out, seed,
     *, mesh, axis: str = "model", max_len: int, greedy: bool,
     temperature: float = 1.0, suppress_unk: bool = False,
+    quant=None, compute_dtype=None,
 ):
     """Sharded fused sample (attention fusion) — the shard_map port of
     :func:`ops.pallas_sampler.attlstm_sample`, same argument and
-    ``(tokens, logprobs, mask)`` return contract."""
+    ``(tokens, logprobs, mask)`` return contract (including the int8w
+    ``quant``/``compute_dtype`` kwargs)."""
     return _sharded_sample_impl(
         gx_static, w_x, wh,
         (w_ctx, att_wh, att_v, att_proj, att_mask, att_vals),
         emb, w_out, b_out, seed, mesh, axis, max_len, greedy,
-        temperature, suppress_unk,
+        temperature, suppress_unk, quant=quant,
+        compute_dtype=compute_dtype,
     )
 
 
@@ -475,12 +598,14 @@ def sharded_lstm_sample(
     gx_static, w_x, wh, emb, w_out, b_out, seed,
     *, mesh, axis: str = "model", max_len: int, greedy: bool,
     temperature: float = 1.0, suppress_unk: bool = False,
+    quant=None, compute_dtype=None,
 ):
     """Static-context (meanpool) sharded fused sample — the shard_map
     port of :func:`ops.pallas_sampler.lstm_sample`."""
     return _sharded_sample_impl(
         gx_static, w_x, wh, None, emb, w_out, b_out, seed,
         mesh, axis, max_len, greedy, temperature, suppress_unk,
+        quant=quant, compute_dtype=compute_dtype,
     )
 
 

@@ -1,0 +1,46 @@
+# corpus-rules: dtypeflow
+"""Seeded ISSUE-20 in-kernel dequant violations: the fused decode
+kernels stream int8 vocab/gate code tiles and dequantize in-kernel —
+per-channel scale applied AFTER an f32-pinned accumulation
+(``ops/quant.py::quant_matmul`` semantics).  Two ways that contract
+decays: an unregistered code-tile cast reachable from a jit root (001 —
+no CAST_REGISTRY entry claiming the relaxed-serving tier for the
+quantization rounding) and a registered in-kernel dequant whose GEMM
+loses the f32 accumulation pin (003 — multiplying the per-channel scale
+into a bf16 accumulator does not un-round it; the corpus test injects
+the ``low_precision=True`` entry for ``registered_kernel_dequant``).
+The negative case is the kernels' exact vloop idiom: registered cast,
+pinned f32 accumulation, per-logit scale applied after, f32 bias."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def unregistered_kernel_dequant(h, q_tile, scale_tile):
+    # a streamed int8 code tile cast to the activation dtype with no
+    # CAST_REGISTRY entry naming the parity tier that survives the
+    # quantization rounding
+    w = q_tile.astype(jnp.bfloat16)  # expect: CST-DTY-001
+    return jnp.matmul(
+        h, w, preferred_element_type=jnp.float32
+    ) * scale_tile
+
+
+@jax.jit
+def registered_kernel_dequant(h, q_tile, scale_tile, bias_tile):
+    # the cast sites are registered (relaxed-serving entry injected by
+    # the corpus test) ...
+    hc = h.astype(jnp.bfloat16)
+    wc = q_tile.astype(jnp.bfloat16)
+    # ... but the post-accumulation scale multiply only preserves
+    # quant_matmul semantics over an f32-PINNED accumulator — scaling a
+    # bf16 accumulation does not un-round it
+    bad = jnp.matmul(hc, wc) * scale_tile  # expect: CST-DTY-003
+    # negative: the fused kernels' vloop idiom — codes cast losslessly
+    # to the activation dtype, f32 accumulation pinned, per-logit scale
+    # applied after the accumulation, f32 bias, no cdt rounding
+    good = jnp.matmul(
+        hc, wc, preferred_element_type=jnp.float32
+    ) * scale_tile + bias_tile
+    return bad + good

@@ -459,6 +459,14 @@ class TestCorpus:
             "corpus-injected quantized decision path",
             low_precision=True,
         )
+        kern_key = (
+            "typeflow/quant_kernel_bad.py::registered_kernel_dequant"
+        )
+        CAST_REGISTRY[kern_key] = CastSite(
+            "relaxed-serving",
+            "corpus-injected in-kernel dequant path",
+            low_precision=True,
+        )
         # configflow's doc-coverage rule (CST-CFG-003) runs against the
         # corpus's own docs twin; every other family runs doc-less.
         cfg_ctx = CheckContext(
@@ -475,6 +483,7 @@ class TestCorpus:
             del JIT_SITE_REGISTRY[key]
             del CAST_REGISTRY[cast_key]
             del CAST_REGISTRY[quant_key]
+            del CAST_REGISTRY[kern_key]
         return findings
 
     def test_every_seeded_violation_fires_exactly_its_rule(

@@ -332,6 +332,64 @@ class TestBenchKind:
         with pytest.raises(ValueError, match="lowprec_int8w_tp2"):
             validate_record(rec)
 
+    def test_lowprec_fused_row_passes(self):
+        """A well-formed fused×int8w composition row (ISSUE 20): rides
+        the lowprec_* numeric contract, tile ratios exactly 0.25,
+        extra-decline counts exactly 0."""
+        rec = good_bench()
+        rec["extra"].update({
+            "lowprec_fused_mesh_shape": "1x2",
+            "lowprec_fused_jax_platforms": "cpu",
+            "lowprec_fused_host_cores": 1.0,
+            "lowprec_fused_match_floor": 0.75,
+            "lowprec_fused_int8w_fused_captions_per_sec": 2284.3,
+            "lowprec_fused_int8w_unfused_captions_per_sec": 1737.8,
+            "lowprec_fused_int8w_fused_p99_batch_ms": 3.77,
+            "lowprec_fused_int8w_match_rate": 1.0,
+            "lowprec_fused_int8w_tp2_match_rate": 1.0,
+            "lowprec_fused_int8w_score_gap_max": 0.0,
+            "lowprec_fused_vocab_tile_f32_bytes": 131072,
+            "lowprec_fused_vocab_tile_int8w_bytes": 32768,
+            "lowprec_fused_vocab_tile_ratio": 0.25,
+            "lowprec_fused_tp2_vocab_tile_ratio": 0.25,
+            "lowprec_fused_int8w_extra_declines": 0,
+            "lowprec_fused_int8w_tp2_extra_declines": 0,
+            "lowprec_fused_int8w_fused_env_gate_lines": 2,
+            "lowprec_fused_virtual_cpu": 1,
+        })
+        validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [0.5, 1.0, 0.249999])
+    def test_lowprec_fused_tile_ratio_not_quarter_fails(self, bad):
+        """The streamed vocab tile is EXACTLY 0.25x f32 by closed form
+        (int8 codes) — any other ratio means the kernels stopped
+        streaming int8 or the tile arithmetic drifted."""
+        rec = good_bench()
+        rec["extra"]["lowprec_fused_tp2_vocab_tile_ratio"] = bad
+        with pytest.raises(ValueError, match="0.25"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [1, 2.0, -1, True])
+    def test_lowprec_fused_extra_declines_nonzero_fails(self, bad):
+        """serving.dtype=int8w must never gate a requested fused
+        kernel off — the decline lift is the tentpole claim, so the
+        schema pins the count at exactly 0."""
+        rec = good_bench()
+        rec["extra"]["lowprec_fused_int8w_extra_declines"] = bad
+        with pytest.raises(ValueError, match="extra_declines"):
+            validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [True, None, "fused"])
+    def test_non_numeric_lowprec_fused_field_fails(self, bad):
+        """lowprec_fused_* rides the lowprec_ numeric contract — a
+        bool/None/prose measurement fails at the emit site."""
+        rec = good_bench()
+        rec["extra"]["lowprec_fused_int8w_fused_captions_per_sec"] = bad
+        with pytest.raises(
+            ValueError, match="lowprec_fused_int8w_fused_captions"
+        ):
+            validate_record(rec)
+
     def test_spec_row_passes(self):
         """A well-formed speculative-decode row (ISSUE 18): every
         spec_* field numeric by contract, acceptance fractions in the
@@ -352,8 +410,23 @@ class TestBenchKind:
             "spec_baseline_captions_per_sec": 1502.7,
             "spec_p99_tick_ms": 3.9,
             "spec_distill_steps": 60,
+            # ISSUE 20 composition arm: speculation × int8w weights
+            "spec_int8w_token_mismatches": 0,
+            "spec_int8w_acceptance_rate": 0.58,
+            "spec_int8w_tokens_per_tick": 1.9,
+            "spec_int8w_captions_per_sec": 1650.2,
+            "spec_int8w_vs_baseline_ratio": 1.12,
         })
         validate_record(rec)
+
+    @pytest.mark.parametrize("bad", [-0.01, 1.01, True])
+    def test_spec_int8w_acceptance_contract_holds(self, bad):
+        """The int8w composition arm's acceptance fraction rides the
+        same unit-interval contract as the float arm's."""
+        rec = good_bench()
+        rec["extra"]["spec_int8w_acceptance_rate"] = bad
+        with pytest.raises(ValueError, match="spec_int8w_acceptance"):
+            validate_record(rec)
 
     @pytest.mark.parametrize("bad", [True, None, "exact", [0]])
     def test_non_numeric_spec_field_fails(self, bad):
